@@ -1,0 +1,40 @@
+"""parameter_server_tpu — a TPU-native parameter-server training framework.
+
+A from-scratch rebuild of the capabilities of the classic parameter server
+(reference: ``pserver/parameter_server``, the Li et al. OSDI'14 system) designed
+idiomatically for TPU:
+
+- **KV layer** (``kv/``): range-partitioned ``KVServer`` tables living in TPU
+  HBM as (optionally mesh-sharded) ``jax.Array``s, updated by jit-compiled
+  optimizer steps; ``KVWorker`` keeps the classic ``push/pull -> timestamp`` /
+  ``wait(ts)`` API.  (Reference: ``src/parameter/parameter.h``,
+  ``kv_vector.h``, ``kv_map.h`` [U — reference mount empty, public layout].)
+- **Core** (``core/``): Message/Task model with integer timestamps, a
+  BSP/SSP/ASP consistency controller (vector clocks replacing the reference's
+  ``Task.time``/``wait_time`` DAG in ``src/system/executor.h`` [U]), and a
+  Van/Postoffice transport layer whose in-process ``LoopbackVan`` doubles as
+  the deterministic test seam.
+- **Ops** (``ops/``): device-side sparse gather / scatter-add (XLA and Pallas
+  paths), segment pre-combine for duplicate keys, ring attention and Ulysses
+  sequence parallelism, quantization codecs for the DCN plane.
+- **Parallel** (``parallel/``): mesh construction, GSPMD sharding rules,
+  psum-over-ICI gradient pre-reduction (replacing NCCL intra-node
+  pre-reduction per the north star).
+- **Models / learner / data**: Criteo sparse LR, ResNet-50, DLRM, BERT, Llama
+  hybrid; SGD + BCD/DARLIN scaffolds; Criteo/libsvm data pipeline.
+
+See ``SURVEY.md`` at the repo root for the full blueprint and the provenance
+caveat on reference citations ([U] = unverified public-repo layout).  The
+package is built up milestone by milestone — consult the module list (or
+``git log``) rather than this overview for what exists at any given commit.
+"""
+
+__version__ = "0.1.0"
+
+from parameter_server_tpu.config import (  # noqa: F401
+    ConsistencyConfig,
+    ConsistencyMode,
+    OptimizerConfig,
+    TableConfig,
+    TopologyConfig,
+)

@@ -1,0 +1,105 @@
+"""Configuration dataclasses.
+
+The reference uses a two-level config split: process-topology gflags (role,
+scheduler address, worker/server counts) and a text-format protobuf app config
+(data, loss, penalty, learning rate, consistency window).  (Reference:
+``src/app/main.cc`` gflags + ``config/*.conf`` text protos [U].)  We keep the
+same split and much of the field vocabulary, as plain dataclasses.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Optional, Tuple
+
+
+class ConsistencyMode(str, enum.Enum):
+    """Consistency spectrum of the reference's Executor task DAG.
+
+    BSP = depend on all prior iterations; ASP = no dependencies; SSP =
+    bounded staleness of ``max_delay`` iterations.  (Reference:
+    ``src/system/executor.h`` ``Task.time``/``wait_time`` semantics [U].)
+    """
+
+    BSP = "bsp"
+    SSP = "ssp"
+    ASP = "asp"
+
+
+@dataclasses.dataclass(frozen=True)
+class ConsistencyConfig:
+    mode: ConsistencyMode = ConsistencyMode.BSP
+    #: SSP staleness bound (the reference's ``max_delay`` flag); ignored for
+    #: BSP (effectively 0) and ASP (effectively unbounded).
+    max_delay: int = 0
+
+    @property
+    def bound(self) -> Optional[int]:
+        """Staleness bound as an int, or None for unbounded (ASP)."""
+        if self.mode == ConsistencyMode.BSP:
+            return 0
+        if self.mode == ConsistencyMode.SSP:
+            return self.max_delay
+        return None
+
+
+@dataclasses.dataclass(frozen=True)
+class TopologyConfig:
+    """Process/device topology — the reference's gflags layer.
+
+    On TPU the "servers" are shards of a device mesh axis rather than separate
+    processes; ``num_servers`` becomes the number of table shards and
+    ``num_workers`` the number of data-parallel worker slots.
+    """
+
+    num_workers: int = 1
+    num_servers: int = 1
+    #: mesh axis sizes (data, model); data axis carries DP gradient psum
+    #: (the NCCL-pre-reduction replacement), model axis carries table shards.
+    mesh_shape: Tuple[int, ...] = (1, 1)
+    mesh_axis_names: Tuple[str, ...] = ("data", "model")
+
+
+@dataclasses.dataclass(frozen=True)
+class OptimizerConfig:
+    """Server-side update rule for a table.
+
+    ``kind`` in {"sgd", "adagrad", "adam", "ftrl"}; FTRL mirrors the
+    reference's KVMap FTRLEntry{z,n} lazy-weight scheme
+    (``src/app/linear_method/ftrl*.h`` [U]).
+    """
+
+    kind: str = "adagrad"
+    learning_rate: float = 0.1
+    #: L1/L2 regularization (the reference's penalty protos).
+    l1: float = 0.0
+    l2: float = 0.0
+    #: adagrad/adam epsilon; ftrl beta.
+    eps: float = 1e-8
+    beta1: float = 0.9
+    beta2: float = 0.999
+    #: ftrl alpha/beta per the FTRL-proximal paper parameterization.
+    ftrl_alpha: float = 0.05
+    ftrl_beta: float = 1.0
+
+
+@dataclasses.dataclass(frozen=True)
+class TableConfig:
+    """A KV table: the unit the reference range-partitions across servers.
+
+    (Reference: ``src/system/assigner.h`` NodeAssigner key-range split +
+    ``src/parameter/kv_vector.h`` per-channel value arrays [U].)
+    """
+
+    name: str
+    #: number of rows (vocabulary / feature capacity). Sparse tables index
+    #: rows by localized key; dense tensors flatten to rows of ``dim``.
+    rows: int
+    #: value columns per key (the reference's ``k``-column KVVector).
+    dim: int = 1
+    dtype: str = "float32"
+    optimizer: OptimizerConfig = dataclasses.field(default_factory=OptimizerConfig)
+    #: if True the table is sharded over the mesh "model" axis (row-wise,
+    #: contiguous ranges — the NodeAssigner scheme); if False it is replicated.
+    sharded: bool = True

@@ -1,0 +1,1 @@
+"""Host-side utilities: key localization, sketches, metrics, checkpointing."""

@@ -1,0 +1,165 @@
+"""Key localization: global 64-bit feature keys -> dense local row ids.
+
+This is the host-side half of the reference's core sparse trick
+(``src/util/localizer.h`` :: ``Localizer`` [U]): global keys from parsed
+examples are deduplicated and remapped to a compact dense id space so the
+device only ever sees fixed-shape integer-indexed batches.  The device-side
+half (gather / scatter-add over the row table) lives in
+``parameter_server_tpu.ops.scatter`` (built in the same round as this module;
+if that import fails you are looking at an intermediate tree).
+
+Two flavors:
+
+- :func:`localize_batch` — stateless per-batch dedup (np.unique), what the
+  reference does per feature block.
+- :class:`Localizer` — a persistent growing vocabulary mapping global keys to
+  stable row slots, used by streaming learners (FTRL) where a key must keep
+  its optimizer state across batches.
+
+Shapes fed to jit-compiled code must be static; :func:`bucket_size` pads
+unique-key counts to a small set of bucket sizes so recompilation happens at
+most ``O(log(max_keys))`` times (SURVEY.md §7 hard part #1).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+#: Sentinel padding key: never a valid feature key. Padded rows scatter into a
+#: dedicated trash row on device (see ops.scatter), so no masking is needed on
+#: the hot path.
+PAD_KEY = np.uint64(0xFFFFFFFFFFFFFFFF)
+
+
+def bucket_size(n: int, *, min_bucket: int = 256) -> int:
+    """Round ``n`` up to the next power-of-two bucket (>= min_bucket).
+
+    Bucketing the number of unique keys per batch keeps jit cache size
+    logarithmic in batch size instead of recompiling per distinct count.
+    """
+    if n <= min_bucket:
+        return min_bucket
+    return 1 << int(np.ceil(np.log2(n)))
+
+
+def localize_batch(
+    keys: np.ndarray, *, pad_to_bucket: bool = True, min_bucket: int = 256
+) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Deduplicate a batch of global keys.
+
+    Args:
+      keys: int/uint array of global feature keys, any shape; flattened.
+      pad_to_bucket: pad the unique-key array with :data:`PAD_KEY` up to a
+        power-of-two bucket so downstream jit sees few distinct shapes.
+
+    Returns:
+      ``(unique_keys, inverse, n_unique)`` where ``unique_keys`` is sorted
+      (padded with PAD_KEY at the tail if requested), ``inverse`` maps each
+      input position to its row in ``unique_keys``, and ``n_unique`` is the
+      true (unpadded) unique count.
+
+    The sortedness of ``unique_keys`` is what lets the server side slice by
+    key range with binary search (reference ``Parameter::Slice`` [U]).
+    """
+    # Keys are uint64 by contract; coerce signed parser output so PAD_KEY
+    # padding cannot wrap to -1 and break the sortedness invariant.
+    flat = np.ascontiguousarray(keys).ravel().astype(np.uint64, copy=False)
+    uniq, inverse = np.unique(flat, return_inverse=True)
+    n_unique = int(uniq.shape[0])
+    if pad_to_bucket:
+        cap = bucket_size(n_unique, min_bucket=min_bucket)
+        if cap > n_unique:
+            pad = np.full(cap - n_unique, PAD_KEY, dtype=uniq.dtype)
+            uniq = np.concatenate([uniq, pad])
+    return uniq, inverse.astype(np.int32), n_unique
+
+
+def slice_by_ranges(
+    sorted_keys: np.ndarray, range_bounds: np.ndarray
+) -> np.ndarray:
+    """Partition sorted keys into server key ranges.
+
+    ``range_bounds`` is the ``num_servers + 1`` ascending boundary array from
+    the NodeAssigner-style even split of the key space (reference
+    ``src/system/assigner.h`` [U]).  Returns the ``num_servers + 1`` split
+    indices into ``sorted_keys`` (use ``searchsorted`` semantics: server ``s``
+    owns ``sorted_keys[idx[s]:idx[s+1]]``).
+    """
+    return np.searchsorted(sorted_keys, range_bounds, side="left")
+
+
+def even_key_ranges(num_servers: int, key_space: int = 2**64) -> np.ndarray:
+    """Evenly split ``[0, key_space)`` into ``num_servers`` contiguous ranges.
+
+    Defaults to the full uint64 space (which :func:`localize_batch` produces —
+    signed parser keys wrap into the top half).  The returned array has
+    ``num_servers + 1`` bounds; since ``2**64`` itself is not representable,
+    the final bound saturates to ``2**64 - 1`` (== :data:`PAD_KEY`) — PAD keys
+    are excluded from server slicing anyway (callers slice ``uniq[:n]``).
+    """
+    if not (0 < key_space <= 2**64):
+        raise ValueError("key_space must be in (0, 2**64]")
+    step = key_space // num_servers
+    bounds_py = [min(i * step, 2**64 - 1) for i in range(num_servers)]
+    bounds_py.append(min(key_space, 2**64 - 1))
+    return np.array(bounds_py, dtype=np.uint64)
+
+
+class Localizer:
+    """Persistent global-key -> stable dense row-slot mapping.
+
+    Streaming learners (async SGD / FTRL over an unbounded key stream) need a
+    key to map to the *same* table row every time so its optimizer state
+    accumulates.  The reference keeps this in the server's hash map
+    (``src/parameter/kv_map.h`` :: ``KVMap`` [U]); on TPU the table is a fixed
+    ``[capacity, dim]`` HBM array, so the hash lives on the host and hands the
+    device dense row ids.
+
+    When the vocabulary overflows ``capacity``, new keys hash-share rows
+    (feature hashing) rather than erroring — matching large-scale CTR practice
+    and the reference's countmin-based tail filtering spirit.
+    """
+
+    def __init__(self, capacity: int):
+        if capacity <= 0:
+            raise ValueError("capacity must be positive")
+        self.capacity = capacity
+        self._map: dict[int, int] = {}
+        self._overflowed = False
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+    @property
+    def overflowed(self) -> bool:
+        return self._overflowed
+
+    def assign(self, unique_keys: np.ndarray) -> np.ndarray:
+        """Map unique global keys to row slots, growing the vocab as needed.
+
+        PAD_KEY maps to slot ``capacity`` (the trash row — tables allocate
+        ``capacity + 1`` rows; see ops.scatter).
+        """
+        out = np.empty(unique_keys.shape[0], dtype=np.int32)
+        m = self._map
+        cap = self.capacity
+        for i, k in enumerate(unique_keys.tolist()):
+            if k == int(PAD_KEY):
+                out[i] = cap
+                continue
+            slot = m.get(k)
+            if slot is None:
+                if len(m) < cap:
+                    slot = len(m)
+                    m[k] = slot
+                else:
+                    # Feature-hashing fallback on overflow. Deterministic pure
+                    # function of the key — deliberately NOT cached, so host
+                    # memory stays bounded by ``capacity`` on unbounded
+                    # streaming key sets.
+                    self._overflowed = True
+                    slot = k % cap
+            out[i] = slot
+        return out

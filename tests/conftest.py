@@ -1,0 +1,16 @@
+"""Test environment: force an 8-device virtual CPU mesh.
+
+Multi-device behavior (sharding, collectives, psum-before-push) is tested on
+one host by faking 8 CPU devices, mirroring how the reference tests multi-node
+via N processes over loopback ZMQ (SURVEY.md §4).  Must run before jax import.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

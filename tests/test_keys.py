@@ -1,0 +1,116 @@
+import numpy as np
+import pytest
+
+from parameter_server_tpu.utils.keys import (
+    PAD_KEY,
+    Localizer,
+    bucket_size,
+    even_key_ranges,
+    localize_batch,
+    slice_by_ranges,
+)
+from parameter_server_tpu.utils.countmin import CountMin
+
+
+def test_bucket_size_powers_of_two():
+    assert bucket_size(1) == 256
+    assert bucket_size(256) == 256
+    assert bucket_size(257) == 512
+    assert bucket_size(1000) == 1024
+    assert bucket_size(1024) == 1024
+
+
+def test_localize_batch_roundtrip():
+    rng = np.random.default_rng(0)
+    keys = rng.integers(0, 10_000, size=(32, 17), dtype=np.uint64)
+    uniq, inv, n = localize_batch(keys)
+    # inverse reconstructs the input
+    np.testing.assert_array_equal(uniq[inv].reshape(keys.shape), keys)
+    # sortedness (excluding pad tail)
+    assert np.all(np.diff(uniq[:n].astype(np.int64)) > 0)
+    # padding
+    assert uniq.shape[0] == bucket_size(n)
+    assert np.all(uniq[n:] == PAD_KEY)
+
+
+def test_localize_batch_no_pad():
+    uniq, inv, n = localize_batch(np.array([5, 3, 5, 1]), pad_to_bucket=False)
+    np.testing.assert_array_equal(uniq, [1, 3, 5])
+    assert n == 3
+
+
+def test_slice_by_ranges():
+    bounds = even_key_ranges(4, key_space=100)
+    keys = np.array([0, 10, 24, 25, 30, 70, 99], dtype=np.uint64)
+    idx = slice_by_ranges(keys, bounds)
+    # server 0 owns [0,25): keys 0,10,24
+    assert idx[0] == 0 and idx[1] == 3
+    # server 1 owns [25,50): keys 25,30
+    assert idx[2] == 5
+    # server 3 owns [75,100): key 99
+    assert idx[3] == 6 and idx[4] == 7
+
+
+def test_localizer_stable_slots():
+    loc = Localizer(capacity=100)
+    a = loc.assign(np.array([7, 3, 9], dtype=np.uint64))
+    b = loc.assign(np.array([9, 7, 11], dtype=np.uint64))
+    assert b[0] == a[2] and b[1] == a[0]  # same key -> same slot
+    assert len(loc) == 4
+    assert not loc.overflowed
+
+
+def test_localizer_pad_key_to_trash_row():
+    loc = Localizer(capacity=10)
+    slots = loc.assign(np.array([1, PAD_KEY], dtype=np.uint64))
+    assert slots[1] == 10  # trash row == capacity
+
+
+def test_localizer_overflow_hashes():
+    loc = Localizer(capacity=4)
+    slots = loc.assign(np.arange(10, dtype=np.uint64))
+    assert loc.overflowed
+    assert np.all(slots < 4)
+    # stable even after overflow
+    again = loc.assign(np.arange(10, dtype=np.uint64))
+    np.testing.assert_array_equal(slots, again)
+
+
+def test_even_key_ranges_full_uint64():
+    bounds = even_key_ranges(4)  # default: full uint64 space
+    assert bounds[0] == 0 and bounds[-1] == np.uint64(2**64 - 1)
+    # a top-bit-set key (e.g. wrapped signed key) is owned by the last server
+    keys = np.array([2**63 + 5], dtype=np.uint64)
+    idx = slice_by_ranges(keys, bounds)
+    assert idx[2] == 0 and idx[3] == 1  # falls in server 2's range [2^63, 3*2^62)
+
+
+def test_localizer_bounded_after_overflow():
+    loc = Localizer(capacity=4)
+    loc.assign(np.arange(1000, dtype=np.uint64))
+    # dict stays bounded by capacity; overflow keys hash, not cached
+    assert len(loc) == 4 and loc.overflowed
+
+
+def test_localizer_bad_capacity():
+    with pytest.raises(ValueError):
+        Localizer(capacity=0)
+
+
+def test_countmin_never_undercounts():
+    cm = CountMin(width=1 << 12, depth=4)
+    rng = np.random.default_rng(1)
+    keys = rng.integers(0, 500, size=5000, dtype=np.uint64)
+    cm.add(keys)
+    true_counts = np.bincount(keys.astype(np.int64), minlength=500)
+    est = cm.query(np.arange(500, dtype=np.uint64))
+    assert np.all(est >= true_counts)
+    # with a wide sketch estimates should be close
+    assert np.mean(est - true_counts) < 1.0
+
+
+def test_countmin_filter():
+    cm = CountMin(width=1 << 12, depth=4)
+    cm.add(np.array([42] * 10 + [7], dtype=np.uint64))
+    mask = cm.filter(np.array([42, 7, 99], dtype=np.uint64), threshold=5)
+    assert mask.tolist() == [True, False, False]
