@@ -1,0 +1,235 @@
+"""Device-side sparse table primitives: row gather / scatter-add.
+
+These are the TPU equivalents of the reference server's hot loops
+(``src/parameter/kv_vector.h`` :: ``ParallelOrderedMatch`` merge + scatter-ADD
+into the value array, and the Pull-side row gather [U — reference mount empty,
+public layout]).  The host has already localized global keys to dense row ids
+(:mod:`parameter_server_tpu.utils.keys`), so the device only sees fixed-shape
+``int32`` row-id vectors.
+
+Two implementations:
+
+- **XLA** (default): ``jnp.take`` / ``.at[].add``.  Differentiable, handles
+  duplicate ids, runs everywhere.  XLA lowers these to native gather/scatter
+  which is adequate for small-dim tables (e.g. LR weights).
+- **Pallas** (``impl="pallas"``): a double-buffer-free DMA kernel that copies
+  ``block_rows`` table rows HBM→VMEM per grid step via scalar-prefetched ids,
+  adds, and writes back.  The table never materializes in VMEM, so capacity is
+  bounded by HBM only.  Requires: unique row ids (pre-combined duplicates —
+  exactly what :func:`localize_batch` + :func:`segment_combine` produce),
+  ``dim % 128 == 0``, float32.  Padding rows must carry zero values and may
+  all point at the shared trash row (writes become idempotent ``+0``).
+
+The duplicate-key pre-combine that the reference does inside
+``ParallelOrderedMatch`` happens here as a device-side ``segment_sum``
+(:func:`segment_combine`) keyed by the localizer's inverse indices.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Literal
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+Impl = Literal["auto", "xla", "pallas"]
+
+#: rows copied per pallas grid step; 8 == f32 sublane count.
+_BLOCK_ROWS = 8
+
+
+def segment_combine(values: jax.Array, inverse: jax.Array, num_rows: int) -> jax.Array:
+    """Sum per-position values into their unique-key rows.
+
+    ``inverse`` is the position->unique-row map from ``localize_batch``;
+    ``num_rows`` the (bucket-padded) unique count.  Rows past the true unique
+    count receive zero — exactly the padding contract the pallas scatter path
+    requires.
+    """
+    return jax.ops.segment_sum(values, inverse, num_segments=num_rows)
+
+
+# ---------------------------------------------------------------------------
+# XLA implementations
+# ---------------------------------------------------------------------------
+
+
+def gather_rows_xla(table: jax.Array, ids: jax.Array) -> jax.Array:
+    return jnp.take(table, ids, axis=0)
+
+
+def scatter_add_rows_xla(table: jax.Array, ids: jax.Array, rows: jax.Array) -> jax.Array:
+    return table.at[ids].add(rows)
+
+
+def scatter_update_rows_xla(table: jax.Array, ids: jax.Array, rows: jax.Array) -> jax.Array:
+    return table.at[ids].set(rows)
+
+
+# ---------------------------------------------------------------------------
+# Pallas implementations
+# ---------------------------------------------------------------------------
+
+
+def _gather_kernel(ids_ref, table_ref, out_ref, sems):
+    i = pl.program_id(0)
+    for k in range(_BLOCK_ROWS):
+        row = ids_ref[i * _BLOCK_ROWS + k]
+        pltpu.make_async_copy(table_ref.at[row], out_ref.at[k], sems.at[k]).start()
+    for k in range(_BLOCK_ROWS):
+        row = ids_ref[i * _BLOCK_ROWS + k]
+        pltpu.make_async_copy(table_ref.at[row], out_ref.at[k], sems.at[k]).wait()
+
+
+def _check_pallas_args(table: jax.Array, ids: jax.Array) -> None:
+    if ids.shape[0] % _BLOCK_ROWS != 0:
+        raise ValueError(
+            f"pallas path requires len(ids) % {_BLOCK_ROWS} == 0, got {ids.shape[0]}; "
+            "bucket-pad ids (utils.keys.localize_batch) or use impl='xla'"
+        )
+    if table.ndim != 2 or table.shape[1] % 128 != 0 or table.dtype != jnp.float32:
+        raise ValueError(
+            f"pallas path requires a 2-D float32 table with dim % 128 == 0, got "
+            f"{table.shape} {table.dtype}; use impl='xla'"
+        )
+
+
+def _pallas_gather(table: jax.Array, ids: jax.Array, *, interpret: bool) -> jax.Array:
+    _check_pallas_args(table, ids)
+    n = ids.shape[0]
+    dim = table.shape[1]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n // _BLOCK_ROWS,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(
+            (_BLOCK_ROWS, dim), lambda i, ids: (i, 0), memory_space=pltpu.VMEM
+        ),
+        scratch_shapes=[pltpu.SemaphoreType.DMA((_BLOCK_ROWS,))],
+    )
+    return pl.pallas_call(
+        _gather_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n, dim), table.dtype),
+        interpret=interpret,
+    )(ids, table)
+
+
+def _scatter_add_kernel(ids_ref, vals_ref, table_ref, out_ref, scratch, sems):
+    # out_ref aliases table_ref (donated input): read rows, add, write back.
+    i = pl.program_id(0)
+    for k in range(_BLOCK_ROWS):
+        row = ids_ref[i * _BLOCK_ROWS + k]
+        pltpu.make_async_copy(out_ref.at[row], scratch.at[k], sems.at[k]).start()
+    for k in range(_BLOCK_ROWS):
+        row = ids_ref[i * _BLOCK_ROWS + k]
+        pltpu.make_async_copy(out_ref.at[row], scratch.at[k], sems.at[k]).wait()
+    scratch[...] = scratch[...] + vals_ref[...]
+    for k in range(_BLOCK_ROWS):
+        row = ids_ref[i * _BLOCK_ROWS + k]
+        pltpu.make_async_copy(scratch.at[k], out_ref.at[row], sems.at[k]).start()
+    for k in range(_BLOCK_ROWS):
+        row = ids_ref[i * _BLOCK_ROWS + k]
+        pltpu.make_async_copy(scratch.at[k], out_ref.at[row], sems.at[k]).wait()
+
+
+def _pallas_scatter_add(
+    table: jax.Array, ids: jax.Array, rows: jax.Array, *, interpret: bool
+) -> jax.Array:
+    _check_pallas_args(table, ids)
+    n = ids.shape[0]
+    dim = table.shape[1]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n // _BLOCK_ROWS,),
+        in_specs=[
+            pl.BlockSpec(
+                (_BLOCK_ROWS, dim), lambda i, ids: (i, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[
+            pltpu.VMEM((_BLOCK_ROWS, dim), table.dtype),
+            pltpu.SemaphoreType.DMA((_BLOCK_ROWS,)),
+        ],
+    )
+    return pl.pallas_call(
+        _scatter_add_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(table.shape, table.dtype),
+        input_output_aliases={2: 0},  # table (arg idx incl. scalar prefetch) -> out
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+    )(ids, rows, table)
+
+
+def _pallas_ok(table: jax.Array, ids: jax.Array) -> bool:
+    return (
+        table.ndim == 2
+        and table.dtype == jnp.float32
+        and table.shape[1] % 128 == 0
+        and ids.shape[0] % _BLOCK_ROWS == 0
+    )
+
+
+# ---------------------------------------------------------------------------
+# Public dispatchers
+# ---------------------------------------------------------------------------
+
+
+def _on_tpu() -> bool:
+    # The axon PJRT plugin used in the dev environment also reports "tpu".
+    return jax.default_backend() == "tpu"
+
+
+def gather_rows(
+    table: jax.Array, ids: jax.Array, *, impl: Impl = "auto", interpret: bool = False
+) -> jax.Array:
+    """Gather ``table[ids]`` (Pull hot loop #2 of the reference server)."""
+    if impl == "xla" or (impl == "auto" and not (_on_tpu() and _pallas_ok(table, ids))):
+        return gather_rows_xla(table, ids)
+    return _pallas_gather(table, ids, interpret=interpret)
+
+
+def scatter_add_rows(
+    table: jax.Array,
+    ids: jax.Array,
+    rows: jax.Array,
+    *,
+    impl: Impl = "auto",
+    interpret: bool = False,
+) -> jax.Array:
+    """Scatter-add rows into the table (Push hot loop #1 of the reference).
+
+    The pallas path requires unique ``ids`` (pre-combined duplicates); the XLA
+    path accepts duplicates.
+    """
+    if impl == "xla" or (impl == "auto" and not (_on_tpu() and _pallas_ok(table, ids))):
+        return scatter_add_rows_xla(table, ids, rows)
+    return _pallas_scatter_add(table, ids, rows, interpret=interpret)
+
+
+@functools.partial(jax.jit, static_argnames=("num_rows", "unique_ids"))
+def combine_and_scatter_add(
+    table: jax.Array,
+    ids: jax.Array,
+    inverse: jax.Array,
+    values: jax.Array,
+    num_rows: int,
+    unique_ids: bool = False,
+) -> jax.Array:
+    """Fused duplicate pre-combine + scatter-add (the full Push apply).
+
+    ``inverse`` pre-combines duplicates *per unique key*, but distinct keys may
+    still share a row slot once the Localizer overflows (feature hashing), so
+    by default the duplicate-tolerant XLA scatter is used.  Pass
+    ``unique_ids=True`` only when the caller guarantees slot uniqueness (e.g.
+    ``not localizer.overflowed``) to enable the pallas fast path.
+    """
+    combined = segment_combine(values, inverse, num_rows)
+    impl: Impl = "auto" if unique_ids else "xla"
+    return scatter_add_rows(table, ids, combined, impl=impl)

@@ -1,0 +1,124 @@
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from parameter_server_tpu.ops import scatter
+from parameter_server_tpu.utils.keys import localize_batch
+
+
+def _table(rows=64, dim=128, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.normal(size=(rows, dim)).astype(np.float32))
+
+
+def test_gather_xla_matches_numpy():
+    t = _table()
+    ids = jnp.array([3, 0, 3, 63], dtype=jnp.int32)
+    out = scatter.gather_rows(t, ids, impl="xla")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(t)[[3, 0, 3, 63]])
+
+
+def test_scatter_add_xla_duplicates():
+    t = _table(rows=8, dim=128)
+    ids = jnp.array([1, 1, 2], dtype=jnp.int32)
+    rows = jnp.ones((3, 128), dtype=jnp.float32)
+    out = scatter.scatter_add_rows(t, ids, rows, impl="xla")
+    expect = np.asarray(t).copy()
+    expect[1] += 2.0
+    expect[2] += 1.0
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-6)
+
+
+def test_segment_combine_pads_zero():
+    vals = jnp.ones((5, 4), dtype=jnp.float32)
+    inverse = jnp.array([0, 0, 1, 2, 1], dtype=jnp.int32)
+    out = scatter.segment_combine(vals, inverse, num_rows=8)
+    np.testing.assert_allclose(np.asarray(out)[0], 2.0 * np.ones(4))
+    np.testing.assert_allclose(np.asarray(out)[1], 2.0 * np.ones(4))
+    np.testing.assert_allclose(np.asarray(out)[2], 1.0 * np.ones(4))
+    np.testing.assert_allclose(np.asarray(out)[3:], 0.0)
+
+
+def test_combine_and_scatter_add_end_to_end():
+    """Full push apply: raw batch keys -> localize -> combine -> scatter."""
+    capacity, dim = 32, 128
+    table = jnp.zeros((capacity + 1, dim), dtype=jnp.float32)
+    keys = np.array([100, 7, 100, 9, 7, 100], dtype=np.uint64)
+    uniq, inverse, n = localize_batch(keys, min_bucket=8)
+    # dense local ids: pretend localizer assigned slots 0..n-1, pads -> trash
+    slots = np.full(uniq.shape[0], capacity, dtype=np.int32)
+    slots[:n] = np.arange(n)
+    grads = jnp.ones((keys.shape[0], dim), dtype=jnp.float32)
+    out = scatter.combine_and_scatter_add(
+        table, jnp.asarray(slots), jnp.asarray(inverse), grads, uniq.shape[0]
+    )
+    out = np.asarray(out)
+    # uniq sorted: [7, 9, 100]; counts [2, 1, 3]
+    np.testing.assert_allclose(out[0], 2.0)
+    np.testing.assert_allclose(out[1], 1.0)
+    np.testing.assert_allclose(out[2], 3.0)
+    np.testing.assert_allclose(out[3:capacity], 0.0)
+    np.testing.assert_allclose(out[capacity], 0.0)  # trash row got only zeros
+
+
+def test_gather_grad_is_scatter():
+    """XLA gather must be differentiable (backward = scatter-add)."""
+    t = _table(rows=8, dim=128)
+    ids = jnp.array([1, 1, 3], dtype=jnp.int32)
+
+    def loss(tbl):
+        return jnp.sum(scatter.gather_rows(tbl, ids, impl="xla") ** 2)
+
+    g = jax.grad(loss)(t)
+    expect = np.zeros_like(np.asarray(t))
+    tn = np.asarray(t)
+    expect[1] = 2 * 2 * tn[1]  # row 1 gathered twice
+    expect[3] = 2 * tn[3]
+    np.testing.assert_allclose(np.asarray(g), expect, rtol=1e-6)
+
+
+def test_pallas_rejects_unaligned_ids():
+    t = _table(rows=16, dim=128)
+    ids = jnp.array([1, 2, 3], dtype=jnp.int32)  # not a multiple of 8
+    with pytest.raises(ValueError, match="bucket-pad"):
+        scatter._pallas_gather(t, ids, interpret=True)
+    with pytest.raises(ValueError, match="bucket-pad"):
+        scatter._pallas_scatter_add(t, ids, jnp.ones((3, 128)), interpret=True)
+
+
+def test_pallas_rejects_unaligned_dim():
+    t = jnp.zeros((16, 100), dtype=jnp.float32)
+    ids = jnp.arange(8, dtype=jnp.int32)
+    with pytest.raises(ValueError, match="dim % 128"):
+        scatter._pallas_gather(t, ids, interpret=True)
+
+
+def test_combine_and_scatter_add_duplicate_slots():
+    """Overflowed-localizer case: two unique keys sharing a slot must both land."""
+    table = jnp.zeros((4, 128), dtype=jnp.float32)
+    # unique keys 0,1 both hashed to slot 2
+    ids = jnp.array([2, 2], dtype=jnp.int32)
+    inverse = jnp.array([0, 1], dtype=jnp.int32)
+    vals = jnp.ones((2, 128), dtype=jnp.float32)
+    out = scatter.combine_and_scatter_add(table, ids, inverse, vals, num_rows=2)
+    np.testing.assert_allclose(np.asarray(out)[2], 2.0)
+
+
+@pytest.mark.parametrize("op", ["gather", "scatter_add"])
+def test_pallas_interpret_matches_xla(op):
+    """Pallas kernels in interpret mode on CPU must match the XLA path."""
+    t = _table(rows=64, dim=128)
+    ids = jnp.asarray(np.random.default_rng(1).permutation(64)[:16].astype(np.int32))
+    if op == "gather":
+        got = scatter._pallas_gather(t, ids, interpret=True)
+        want = scatter.gather_rows_xla(t, ids)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want))
+    else:
+        rows = jnp.asarray(
+            np.random.default_rng(2).normal(size=(16, 128)).astype(np.float32)
+        )
+        got = scatter._pallas_scatter_add(t, ids, rows, interpret=True)
+        want = scatter.scatter_add_rows_xla(t, ids, rows)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6)
