@@ -100,6 +100,8 @@ class TableConfig:
     dim: int = 1
     dtype: str = "float32"
     optimizer: OptimizerConfig = dataclasses.field(default_factory=OptimizerConfig)
+    #: stddev of normal init for value rows; 0.0 = zeros (LR weights).
+    init_scale: float = 0.0
     #: if True the table is sharded over the mesh "model" axis (row-wise,
     #: contiguous ranges — the NodeAssigner scheme); if False it is replicated.
     sharded: bool = True

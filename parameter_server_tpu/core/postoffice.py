@@ -1,0 +1,155 @@
+"""Postoffice + Customer: per-node message hub and async RPC bookkeeping.
+
+Reference roles (``src/system/postoffice.h``, ``src/system/customer.h`` [U]):
+the Postoffice is the per-process hub that owns the Van and routes inbound
+messages to Customers; a Customer issues tasks (``Submit -> timestamp``),
+tracks outstanding responses, and exposes ``Wait(ts)``.  The Executor's
+per-sender ordering bookkeeping is folded into Customer here: the LoopbackVan
+delivers per-sender FIFO and same-sender ``wait_time`` dependencies are
+therefore satisfied structurally; cross-worker staleness gating happens at
+dispatch time via :class:`~parameter_server_tpu.core.clock.ConsistencyController`
+(SURVEY.md §7 design stance: gate dispatch, don't park device work).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from parameter_server_tpu.core.messages import Message, TimestampGenerator
+from parameter_server_tpu.core.van import Van
+
+
+class Postoffice:
+    """Per-node hub: binds the node's Van endpoint, routes to customers."""
+
+    def __init__(self, node_id: str, van: Van) -> None:
+        self.node_id = node_id
+        self.van = van
+        self._customers: dict[str, "Customer"] = {}
+        van.bind(node_id, self._on_recv)
+
+    def register(self, customer: "Customer") -> None:
+        if customer.name in self._customers:
+            raise ValueError(f"customer {customer.name!r} already registered")
+        self._customers[customer.name] = customer
+
+    def send(self, msg: Message) -> bool:
+        msg.sender = self.node_id
+        return self.van.send(msg)
+
+    def _on_recv(self, msg: Message) -> None:
+        customer = self._customers.get(msg.task.customer)
+        if customer is None:
+            return  # unknown customer: drop (matches reference glog-and-drop)
+        if msg.is_request:
+            reply = customer.process_request(msg)
+            if reply is not None:
+                self.van.send(reply)
+        else:
+            customer._on_response(msg)
+
+
+class Customer:
+    """Async task issuer/handler bound to one Postoffice node.
+
+    ``submit`` assigns a timestamp, sends one message per receiver, and
+    records how many responses complete the task; ``wait`` blocks on that.
+    Server-side subclasses override :meth:`handle_request` to produce reply
+    values (the reference's ``Parameter::GetValue/SetValue`` seam).
+    """
+
+    def __init__(self, name: str, post: Postoffice) -> None:
+        self.name = name
+        self.post = post
+        self._ts = TimestampGenerator()
+        self._pending: dict[int, int] = {}
+        self._callbacks: dict[int, Callable[[list[Message]], None]] = {}
+        self._responses: dict[int, list[Message]] = {}
+        self._executed: dict[str, int] = {}  # per-sender executed task time
+        self._cond = threading.Condition()
+        post.register(self)
+
+    # -- requester side -----------------------------------------------------
+    def submit(
+        self,
+        msgs: list[Message],
+        callback: Optional[Callable[[list[Message]], None]] = None,
+    ) -> int:
+        """Send one logical task as ``msgs`` (already sliced per receiver).
+
+        All messages share the newly assigned timestamp; the task completes
+        when every receiver has responded.  Returns the timestamp.
+        """
+        ts = self._ts.next()
+        with self._cond:
+            self._pending[ts] = len(msgs)
+            self._responses[ts] = []
+            if callback is not None:
+                self._callbacks[ts] = callback
+        undeliverable = []
+        for m in msgs:
+            m.task.customer = self.name
+            m.task.time = ts
+            if not self.post.send(m):
+                undeliverable.append(m)
+        if undeliverable:
+            # Dead receiver(s): complete their legs immediately so wait()
+            # cannot hang; the learner layer re-assigns work (WorkloadPool).
+            with self._cond:
+                self._pending[ts] -= len(undeliverable)
+                if self._pending[ts] <= 0:
+                    self._finish_locked(ts)
+        return ts
+
+    def wait(self, ts: int, timeout: Optional[float] = None) -> bool:
+        """Block until task ``ts`` has all responses.  False on timeout."""
+        with self._cond:
+            return self._cond.wait_for(lambda: ts not in self._pending, timeout)
+
+    def done(self, ts: int) -> bool:
+        with self._cond:
+            return ts not in self._pending
+
+    def responses(self, ts: int) -> list[Message]:
+        """Collected response messages for a completed task."""
+        with self._cond:
+            return list(self._responses.get(ts, []))
+
+    def _on_response(self, msg: Message) -> None:
+        ts = msg.task.time
+        with self._cond:
+            if ts not in self._pending:
+                return  # late/duplicate response
+            self._responses[ts].append(msg)
+            self._pending[ts] -= 1
+            if self._pending[ts] <= 0:
+                self._finish_locked(ts)
+
+    def _finish_locked(self, ts: int) -> None:
+        del self._pending[ts]
+        cb = self._callbacks.pop(ts, None)
+        responses = self._responses.get(ts, [])
+        self._cond.notify_all()
+        if cb is not None:
+            # Fire outside the lock to allow callbacks to re-submit.
+            threading.Thread(
+                target=cb, args=(responses,), daemon=True
+            ).start()
+
+    # -- responder side -----------------------------------------------------
+    def process_request(self, msg: Message) -> Optional[Message]:
+        """Route an inbound request through :meth:`handle_request`."""
+        reply = self.handle_request(msg)
+        with self._cond:
+            prev = self._executed.get(msg.sender, -1)
+            self._executed[msg.sender] = max(prev, msg.task.time)
+        return reply
+
+    def handle_request(self, msg: Message) -> Optional[Message]:
+        """Override: process a request, return the reply Message (or None)."""
+        raise NotImplementedError
+
+    def executed_time(self, sender: str) -> int:
+        with self._cond:
+            return self._executed.get(sender, -1)

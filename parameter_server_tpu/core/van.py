@@ -1,0 +1,121 @@
+"""Van: the transport layer.
+
+The reference Van owns ZeroMQ sockets, a node table, and a receive thread
+(``src/system/van.h/.cc`` [U]).  Here Van is an abstract seam with two
+implementations planned:
+
+- :class:`LoopbackVan` (this module): in-process delivery between node
+  endpoints via thread-safe queues.  This is both the unit-test seam
+  (deterministic, no sockets — the role loopback-ZMQ plays in the reference's
+  ``script/local.sh`` integration tests, SURVEY.md §4) and the single-host
+  runtime, where scheduler/servers/workers are Python objects sharing one
+  process and the actual tensor traffic rides XLA, not the Van.
+- A DCN Van (``core/dcn_van.py``, later round): cross-host async Push/Pull
+  over TCP for multi-pod deployments; same interface.
+
+Fault injection is first-class: :meth:`LoopbackVan.disconnect` makes a node
+unreachable (dropped messages), emulating a dead socket for failure-path
+tests — something the reference never had (SURVEY.md §4 "opportunity").
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Optional
+
+from parameter_server_tpu.core.messages import Message
+
+
+class Van:
+    """Transport interface: connect endpoints, send messages."""
+
+    def bind(self, node_id: str, handler: Callable[[Message], None]) -> None:
+        raise NotImplementedError
+
+    def send(self, msg: Message) -> bool:
+        """Deliver ``msg`` to ``msg.recver``.  Returns False if unreachable."""
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class _Endpoint:
+    """A bound node: its inbox queue and receive thread."""
+
+    def __init__(self, node_id: str, handler: Callable[[Message], None]) -> None:
+        self.node_id = node_id
+        self.handler = handler
+        self.inbox: "queue.Queue[Optional[Message]]" = queue.Queue()
+        self.thread = threading.Thread(
+            target=self._recv_loop, name=f"van-recv-{node_id}", daemon=True
+        )
+        self.thread.start()
+
+    def _recv_loop(self) -> None:
+        while True:
+            msg = self.inbox.get()
+            if msg is None:
+                return
+            self.handler(msg)
+
+    def stop(self) -> None:
+        self.inbox.put(None)
+        self.thread.join(timeout=5)
+
+
+class LoopbackVan(Van):
+    """In-process Van: queues + one receive thread per bound node.
+
+    Mirrors the reference Van's structure (recv thread pumping a socket) with
+    a queue in place of the socket, so ordering guarantees match: messages
+    from A to B arrive in send order; cross-sender order is unspecified.
+    """
+
+    def __init__(self) -> None:
+        self._endpoints: dict[str, _Endpoint] = {}
+        self._disconnected: set[str] = set()
+        self._lock = threading.Lock()
+        #: counters for the dashboard (reference network_usage.h role).
+        self.sent_messages = 0
+        self.dropped_messages = 0
+
+    def bind(self, node_id: str, handler: Callable[[Message], None]) -> None:
+        with self._lock:
+            if node_id in self._endpoints:
+                raise ValueError(f"node {node_id!r} already bound")
+            self._endpoints[node_id] = _Endpoint(node_id, handler)
+
+    def send(self, msg: Message) -> bool:
+        with self._lock:
+            dead = (
+                msg.recver in self._disconnected
+                or msg.sender in self._disconnected
+            )
+            ep = self._endpoints.get(msg.recver)
+        if dead or ep is None:
+            with self._lock:
+                self.dropped_messages += 1
+            return False
+        with self._lock:
+            self.sent_messages += 1
+        ep.inbox.put(msg)
+        return True
+
+    # -- fault injection ----------------------------------------------------
+    def disconnect(self, node_id: str) -> None:
+        """Simulate a dead node: all traffic to/from it is dropped."""
+        with self._lock:
+            self._disconnected.add(node_id)
+
+    def reconnect(self, node_id: str) -> None:
+        with self._lock:
+            self._disconnected.discard(node_id)
+
+    def close(self) -> None:
+        with self._lock:
+            eps = list(self._endpoints.values())
+            self._endpoints.clear()
+        for ep in eps:
+            ep.stop()

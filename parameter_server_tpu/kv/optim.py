@@ -1,0 +1,152 @@
+"""Server-side optimizers: row-wise update rules applied on Push.
+
+The reference customizes server behavior through ``Parameter::SetValue`` /
+KVMap entry functors — e.g. the FTRL entry keeping ``{z, n}`` and computing
+the weight lazily on Pull (``src/app/linear_method/ftrl*.h`` [U]).  Here an
+optimizer is a pair of pure, jit-friendly functions over *rows* (shape
+``[n, dim]``): ``apply`` consumes gradient rows and per-row optimizer-state
+rows; ``pull_weights`` maps stored value rows to servable weights (identity
+for everything except FTRL).
+
+State lives beside the value table as extra ``[rows, dim]`` arrays, so the
+whole table (value + state) checkpoints and shards uniformly.
+
+These are deliberately *not* optax transforms: PS optimizers act on gathered
+row subsets with per-row step counts, which optax's whole-tree update model
+does not express.  (optax remains the right tool for the dense model path in
+``models/``.)
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+
+from parameter_server_tpu.config import OptimizerConfig
+
+Rows = jax.Array  # [n, dim]
+State = Dict[str, Rows]
+
+
+class ServerOptimizer:
+    """Interface: init per-row state, apply updates, derive pull weights."""
+
+    name = "base"
+
+    def __init__(self, cfg: OptimizerConfig) -> None:
+        self.cfg = cfg
+
+    def state_shapes(self) -> Dict[str, float]:
+        """State array names -> fill value at init."""
+        return {}
+
+    def apply(self, value: Rows, state: State, grad: Rows) -> tuple[Rows, State]:
+        raise NotImplementedError
+
+    def pull_weights(self, value: Rows, state: State) -> Rows:
+        return value
+
+
+class SGD(ServerOptimizer):
+    name = "sgd"
+
+    def apply(self, value, state, grad):
+        cfg = self.cfg
+        g = grad + cfg.l2 * value
+        return value - cfg.learning_rate * g, state
+
+
+class AdaGrad(ServerOptimizer):
+    """AdaGrad with optional L1 truncation — the reference's async-SGD server
+    rule for sparse LR (``src/app/linear_method/async_sgd.h`` [U])."""
+
+    name = "adagrad"
+
+    def state_shapes(self):
+        return {"sum_sq": 0.0}
+
+    def apply(self, value, state, grad):
+        cfg = self.cfg
+        g = grad + cfg.l2 * value
+        sum_sq = state["sum_sq"] + g * g
+        lr = cfg.learning_rate / (jnp.sqrt(sum_sq) + cfg.eps)
+        new = value - lr * g
+        if cfg.l1 > 0:
+            # soft-threshold (proximal L1): shrink toward zero by lr*l1
+            new = jnp.sign(new) * jnp.maximum(jnp.abs(new) - lr * cfg.l1, 0.0)
+        return new, {"sum_sq": sum_sq}
+
+
+class Adam(ServerOptimizer):
+    """Adam with per-row step counts (rows update at different rates under
+    async sparse traffic, so a global step would mis-correct bias)."""
+
+    name = "adam"
+
+    def state_shapes(self):
+        return {"m": 0.0, "v": 0.0, "t": 0.0}
+
+    def apply(self, value, state, grad):
+        cfg = self.cfg
+        g = grad + cfg.l2 * value
+        t = state["t"] + 1.0
+        m = cfg.beta1 * state["m"] + (1 - cfg.beta1) * g
+        v = cfg.beta2 * state["v"] + (1 - cfg.beta2) * g * g
+        m_hat = m / (1 - cfg.beta1**t)
+        v_hat = v / (1 - cfg.beta2**t)
+        new = value - cfg.learning_rate * m_hat / (jnp.sqrt(v_hat) + cfg.eps)
+        return new, {"m": m, "v": v, "t": t}
+
+
+class FTRL(ServerOptimizer):
+    """FTRL-proximal: value array stores ``z``; state stores ``n``.
+
+    Push:  sigma = (sqrt(n + g^2) - sqrt(n)) / alpha
+           z += g - sigma * w      (w = current lazy weight)
+           n += g^2
+    Pull:  w = 0                                  if |z| <= l1
+           w = -(z - sign(z) l1) / ((beta + sqrt(n))/alpha + l2)  otherwise
+
+    Matches the reference FTRLEntry update functor semantics [U].
+    """
+
+    name = "ftrl"
+
+    def state_shapes(self):
+        return {"n": 0.0}
+
+    def pull_weights(self, value, state):
+        cfg = self.cfg
+        z, n = value, state["n"]
+        w = -(z - jnp.sign(z) * cfg.l1) / (
+            (cfg.ftrl_beta + jnp.sqrt(n)) / cfg.ftrl_alpha + cfg.l2
+        )
+        return jnp.where(jnp.abs(z) <= cfg.l1, 0.0, w)
+
+    def apply(self, value, state, grad):
+        cfg = self.cfg
+        z, n = value, state["n"]
+        w = self.pull_weights(z, state)
+        sigma = (jnp.sqrt(n + grad * grad) - jnp.sqrt(n)) / cfg.ftrl_alpha
+        z = z + grad - sigma * w
+        n = n + grad * grad
+        return z, {"n": n}
+
+
+_REGISTRY: Dict[str, Callable[[OptimizerConfig], ServerOptimizer]] = {
+    "sgd": SGD,
+    "adagrad": AdaGrad,
+    "adam": Adam,
+    "ftrl": FTRL,
+}
+
+
+def make_optimizer(cfg: OptimizerConfig) -> ServerOptimizer:
+    try:
+        return _REGISTRY[cfg.kind](cfg)
+    except KeyError:
+        raise ValueError(
+            f"unknown optimizer {cfg.kind!r}; have {sorted(_REGISTRY)}"
+        ) from None

@@ -1,0 +1,66 @@
+"""KVServer: the server-role Customer owning table shards.
+
+Reference analogue: the server process's ``Parameter`` subclass answering
+Push with ``SetValue`` (merge + update) and Pull with ``GetValue`` (gather)
+(``src/parameter/parameter.h`` [U]).  Each KVServer instance owns the local
+row-range shard of every registered table; requests arrive through the Van
+recv thread (one per node — the reference's single-Executor-thread model, so
+table mutation is single-threaded by construction) and the actual math runs
+as the KVTable's jit-compiled device steps.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from parameter_server_tpu.config import TableConfig
+from parameter_server_tpu.core.messages import Message, TaskKind
+from parameter_server_tpu.core.postoffice import Customer, Postoffice
+from parameter_server_tpu.kv.partition import RangePartition
+from parameter_server_tpu.kv.table import KVTable
+
+
+class KVServer(Customer):
+    """Server-side customer: routes Push/Pull to local table shards."""
+
+    def __init__(
+        self,
+        post: Postoffice,
+        table_cfgs: Dict[str, TableConfig],
+        server_index: int,
+        num_servers: int,
+        *,
+        name: str = "kv",
+    ) -> None:
+        super().__init__(name, post)
+        self.server_index = server_index
+        self.partitions = {
+            t: RangePartition(cfg.rows, num_servers) for t, cfg in table_cfgs.items()
+        }
+        self.tables: Dict[str, KVTable] = {
+            t: KVTable(
+                cfg,
+                rows=self.partitions[t].server_rows(server_index),
+                seed=hash((t, server_index)) & 0x7FFFFFFF,
+            )
+            for t, cfg in table_cfgs.items()
+        }
+        #: dashboard counters
+        self.pushes = 0
+        self.pulls = 0
+
+    def handle_request(self, msg: Message) -> Message:
+        table = self.tables[msg.task.payload["table"]]
+        ids = jnp.asarray(msg.keys)
+        if msg.task.kind == TaskKind.PUSH:
+            table.push(ids, jnp.asarray(msg.values[0]))
+            self.pushes += 1
+            return msg.reply()
+        elif msg.task.kind == TaskKind.PULL:
+            rows = table.pull(ids)
+            self.pulls += 1
+            return msg.reply(values=[np.asarray(rows)])
+        raise ValueError(f"unsupported task kind {msg.task.kind}")

@@ -1,0 +1,118 @@
+"""KVTable: a parameter table resident in device memory.
+
+The TPU inversion of the reference server's storage (SURVEY.md §7): where the
+reference keeps a sorted key array + value array per channel and merges pushes
+with ``ParallelOrderedMatch`` (``src/parameter/kv_vector.h`` [U]), here the
+table is a fixed ``[rows + 1, dim]`` ``jax.Array`` in HBM (last row = trash
+row for padding), the host supplies dense unique row ids, and push/pull are
+jit-compiled steps:
+
+- ``push``: segment-combine duplicate positions -> gather value+state rows ->
+  optimizer ``apply`` -> scatter rows back.  Buffers are donated, so the
+  update is in-place in HBM.
+- ``pull``: gather rows -> ``pull_weights`` (lazy FTRL weights etc.).
+
+Shapes are bucket-padded by the host (``utils.keys``), so each table compiles
+one kernel per (bucket, batch) shape pair.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from parameter_server_tpu.config import TableConfig
+from parameter_server_tpu.kv.optim import ServerOptimizer, make_optimizer
+from parameter_server_tpu.ops import scatter
+
+
+class KVTable:
+    """One table (or one row-range shard of a table) on the local device."""
+
+    def __init__(self, cfg: TableConfig, *, rows: Optional[int] = None, seed: int = 0):
+        self.cfg = cfg
+        #: actual row count of this shard (cfg.rows is the global table size);
+        #: one extra trash row is appended for padded ids.
+        self.rows = cfg.rows if rows is None else rows
+        self.dim = cfg.dim
+        dtype = jnp.dtype(cfg.dtype)
+        if cfg.init_scale > 0.0:
+            key = jax.random.PRNGKey(seed)
+            value = (
+                jax.random.normal(key, (self.rows + 1, self.dim), dtype) * cfg.init_scale
+            )
+            value = value.at[self.rows].set(0.0)
+        else:
+            value = jnp.zeros((self.rows + 1, self.dim), dtype)
+        self.value: jax.Array = value
+        self.optimizer: ServerOptimizer = make_optimizer(cfg.optimizer)
+        self.state: Dict[str, jax.Array] = {
+            name: jnp.full((self.rows + 1, self.dim), fill, dtype)
+            for name, fill in self.optimizer.state_shapes().items()
+        }
+        self._push_fn = jax.jit(self._push_impl, donate_argnums=(0, 1))
+        self._pull_fn = jax.jit(self._pull_impl)
+
+    # -- jitted bodies ------------------------------------------------------
+    def _push_impl(self, value, state, ids, combined):
+        v_rows = scatter.gather_rows(value, ids)
+        s_rows = {k: scatter.gather_rows(v, ids) for k, v in state.items()}
+        new_v, new_s = self.optimizer.apply(v_rows, s_rows, combined)
+        value = scatter.scatter_update_rows_xla(value, ids, new_v)
+        state = {
+            k: scatter.scatter_update_rows_xla(state[k], ids, new_s[k])
+            for k in state
+        }
+        # Re-zero the trash row: PAD_KEY positions in real (variable-nnz)
+        # batches legitimately route gradients here; resetting keeps pulls of
+        # padded positions exactly zero and makes duplicate-trash-id scatters
+        # deterministic.
+        value = value.at[-1].set(0.0)
+        fills = self.optimizer.state_shapes()
+        state = {k: state[k].at[-1].set(fills[k]) for k in state}
+        return value, state
+
+    def _pull_impl(self, value, state, ids):
+        v_rows = scatter.gather_rows(value, ids)
+        s_rows = {k: scatter.gather_rows(v, ids) for k, v in state.items()}
+        return self.optimizer.pull_weights(v_rows, s_rows)
+
+    # -- public ops ---------------------------------------------------------
+    def push(self, ids: jax.Array, combined_grads: jax.Array) -> None:
+        """Apply pre-combined gradient rows at unique ``ids`` (in place).
+
+        ``ids`` must be unique (host guarantees via ``localize_to_slots``);
+        padded ids point at the trash row and must carry zero gradients.
+        """
+        self.value, self.state = self._push_fn(
+            self.value, self.state, ids, combined_grads
+        )
+
+    def combine(self, inverse: jax.Array, values: jax.Array, num_rows: int) -> jax.Array:
+        """Worker-side duplicate pre-combine (device segment_sum)."""
+        return _combine_jit(inverse, values, num_rows)
+
+    def pull(self, ids: jax.Array) -> jax.Array:
+        """Servable weight rows for unique ``ids``."""
+        return self._pull_fn(self.value, self.state, ids)
+
+    # -- direct row access (checkpoint, tests, model eval) ------------------
+    def weights(self) -> jax.Array:
+        """Full servable weight table (excluding the trash row)."""
+        return self.optimizer.pull_weights(self.value, self.state)[: self.rows]
+
+    def set_value(self, value: np.ndarray | jax.Array) -> None:
+        if value.shape != (self.rows + 1, self.dim):
+            raise ValueError(
+                f"expected {(self.rows + 1, self.dim)}, got {value.shape}"
+            )
+        self.value = jnp.asarray(value, dtype=self.value.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("num_rows",))
+def _combine_jit(inverse, values, num_rows: int):
+    return scatter.segment_combine(values, inverse, num_rows)

@@ -1,0 +1,144 @@
+"""KVWorker: the classic Push/Pull facade with timestamps.
+
+API parity with the reference worker (north-star requirement): ``push`` /
+``pull`` return an integer timestamp; ``wait(ts)`` blocks; pulls deliver
+values aligned with the request's key positions.  (Reference:
+``src/parameter/parameter.h`` :: ``Parameter::Push/Pull/Wait`` [U].)
+
+Pipeline per call (SURVEY.md §3.2 hot path, TPU mapping):
+
+1. host: ``localize_to_slots`` — dedup keys, map to unique row slots
+   (deterministic ``HashLocalizer`` for multi-worker consistency).
+2. device: ``segment_combine`` duplicate positions (push only) — the
+   worker-side pre-reduction; under a mesh this is where the DP ``psum``
+   lands (parallel/, later milestone).
+3. host: ``RangePartition.slice_ids`` — split the sorted slot segment per
+   server (the reference's ``Parameter::Slice``).
+4. Van: one request per server; responses complete the timestamp.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from parameter_server_tpu.config import TableConfig
+from parameter_server_tpu.core.messages import Message, Task, TaskKind, server_id
+from parameter_server_tpu.core.postoffice import Customer, Postoffice
+from parameter_server_tpu.kv.partition import RangePartition
+from parameter_server_tpu.ops import scatter
+from parameter_server_tpu.utils.keys import HashLocalizer, localize_to_slots
+
+
+@functools.partial(jax.jit, static_argnames=("num_rows",))
+def _segment_combine(inverse, values, num_rows: int):
+    return scatter.segment_combine(values, inverse, num_rows)
+
+
+class KVWorker(Customer):
+    def __init__(
+        self,
+        post: Postoffice,
+        table_cfgs: Dict[str, TableConfig],
+        num_servers: int,
+        *,
+        name: str = "kv",
+        localizers: Optional[Dict[str, HashLocalizer]] = None,
+        min_bucket: int = 256,
+    ) -> None:
+        super().__init__(name, post)
+        self.table_cfgs = table_cfgs
+        self.num_servers = num_servers
+        self.min_bucket = min_bucket
+        self.partitions = {
+            t: RangePartition(cfg.rows, num_servers) for t, cfg in table_cfgs.items()
+        }
+        self.localizers = localizers or {
+            t: HashLocalizer(cfg.rows) for t, cfg in table_cfgs.items()
+        }
+        #: per-timestamp reassembly info for pulls
+        self._pull_plans: Dict[int, dict] = {}
+
+    # -- push ---------------------------------------------------------------
+    def push(self, table: str, keys: np.ndarray, values: np.ndarray) -> int:
+        """Push per-position gradient rows for ``keys``.  Returns timestamp.
+
+        ``values`` has shape ``[len(keys), dim]`` (or ``[len(keys)]`` for
+        dim=1 tables).
+        """
+        cfg = self.table_cfgs[table]
+        vals = np.asarray(values, dtype=cfg.dtype).reshape(keys.size, cfg.dim)
+        slots, inverse, _n = localize_to_slots(
+            keys, self.localizers[table], min_bucket=self.min_bucket
+        )
+        # device-side duplicate pre-combine (worker-side pre-reduction)
+        combined = np.asarray(
+            _segment_combine(jnp.asarray(inverse), jnp.asarray(vals), slots.shape[0])
+        )
+        msgs = []
+        for s, seg, local in self.partitions[table].slice_ids(slots):
+            msgs.append(
+                Message(
+                    task=Task(TaskKind.PUSH, self.name, payload={"table": table}),
+                    recver=server_id(s),
+                    keys=local,
+                    values=[combined[seg]],
+                )
+            )
+        return self.submit(msgs)
+
+    # -- pull ---------------------------------------------------------------
+    def pull(self, table: str, keys: np.ndarray) -> int:
+        """Request weights for ``keys``; fetch with :meth:`pull_result`."""
+        slots, inverse, _n = localize_to_slots(
+            keys, self.localizers[table], min_bucket=self.min_bucket
+        )
+        msgs = []
+        order = {}
+        for s, seg, local in self.partitions[table].slice_ids(slots):
+            order[server_id(s)] = seg
+            msgs.append(
+                Message(
+                    task=Task(TaskKind.PULL, self.name, payload={"table": table}),
+                    recver=server_id(s),
+                    keys=local,
+                )
+            )
+        ts = self.submit(msgs)
+        self._pull_plans[ts] = {
+            "order": order,
+            "inverse": inverse,
+            "n_slots": slots.shape[0],
+            "shape": keys.shape,
+            "table": table,
+        }
+        return ts
+
+    def pull_result(self, ts: int, timeout: Optional[float] = None) -> np.ndarray:
+        """Block for pull ``ts`` and reassemble per-position weight rows.
+
+        Output shape: ``keys.shape + (dim,)`` for dim>1 tables, ``keys.shape``
+        for dim=1.
+        """
+        if not self.wait(ts, timeout):
+            raise TimeoutError(f"pull ts={ts} timed out")
+        plan = self._pull_plans.pop(ts)
+        cfg = self.table_cfgs[plan["table"]]
+        uniq_rows = np.zeros((plan["n_slots"], cfg.dim), dtype=cfg.dtype)
+        for resp in self.responses(ts):
+            seg = plan["order"][resp.sender]
+            uniq_rows[seg] = resp.values[0]
+        out = uniq_rows[plan["inverse"]]
+        if cfg.dim == 1:
+            return out.reshape(plan["shape"])
+        return out.reshape(plan["shape"] + (cfg.dim,))
+
+    def pull_sync(
+        self, table: str, keys: np.ndarray, timeout: Optional[float] = None
+    ) -> np.ndarray:
+        return self.pull_result(self.pull(table, keys), timeout)
+

@@ -1,0 +1,187 @@
+"""SGD learners: the minibatch pull -> grad -> push scaffolds.
+
+Reference analogue: ``src/learner/sgd.h`` minibatch scaffolds plus the async
+SGD / FTRL worker loops of ``src/app/linear_method/async_sgd.h`` [U].
+
+Two drivers over the same model math (``models/linear.py``):
+
+- :class:`LocalLRTrainer` — single-process fast path: the table lives on the
+  local device and each step is one fused XLA program.  This is the
+  examples/sec/chip bench path (BASELINE config #1).
+- :class:`AsyncLRLearner` — the classic PS topology over the Van: N worker
+  threads pull/push through :class:`~parameter_server_tpu.kv.worker.KVWorker`
+  under a :class:`~parameter_server_tpu.core.clock.ConsistencyController`
+  (BSP/SSP/ASP), servers apply updates.  This is the semantics/API path and
+  the seam where DCN multi-host traffic will attach.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from parameter_server_tpu.config import (
+    ConsistencyConfig,
+    OptimizerConfig,
+    TableConfig,
+)
+from parameter_server_tpu.core.clock import ConsistencyController
+from parameter_server_tpu.kv.optim import make_optimizer
+from parameter_server_tpu.kv.table import KVTable
+from parameter_server_tpu.kv.worker import KVWorker
+from parameter_server_tpu.models import linear
+from parameter_server_tpu.utils import metrics as metrics_lib
+from parameter_server_tpu.utils.keys import HashLocalizer, localize_to_slots
+
+Batch = Tuple[np.ndarray, np.ndarray]  # (keys [B, nnz], labels [B])
+BatchFn = Callable[[], Batch]
+
+
+class LocalLRTrainer:
+    """Single-device sparse LR: fused pull+grad+apply+scatter per step."""
+
+    def __init__(
+        self,
+        table_cfg: TableConfig,
+        *,
+        min_bucket: int = 1024,
+        dashboard: Optional[metrics_lib.Dashboard] = None,
+    ) -> None:
+        if table_cfg.dim != 1:
+            raise ValueError("LR weight table must have dim=1")
+        self.cfg = table_cfg
+        self.table = KVTable(table_cfg)
+        self.optimizer = self.table.optimizer
+        self.localizer = HashLocalizer(table_cfg.rows)
+        self.min_bucket = min_bucket
+        self.bias = jnp.zeros((1, 1), dtype=jnp.float32)
+        self.bias_state = {
+            k: jnp.zeros((1, 1), dtype=jnp.float32)
+            for k in self.optimizer.state_shapes()
+        }
+        self.dashboard = dashboard or metrics_lib.Dashboard(print_every=0)
+        self.step_count = 0
+
+    def step(self, keys: np.ndarray, labels: np.ndarray) -> float:
+        slots, inverse, _n = localize_to_slots(
+            keys, self.localizer, min_bucket=self.min_bucket
+        )
+        t = self.table
+        t.value, t.state, self.bias, self.bias_state, loss = linear.fused_train_step(
+            t.value,
+            t.state,
+            self.bias,
+            self.bias_state,
+            jnp.asarray(slots),
+            jnp.asarray(inverse),
+            jnp.asarray(labels),
+            self.optimizer,
+            slots.shape[0],
+        )
+        self.step_count += 1
+        return float(loss)
+
+    def train(self, batch_fn: BatchFn, num_steps: int) -> None:
+        for _ in range(num_steps):
+            keys, labels = batch_fn()
+            loss = self.step(keys, labels)
+            self.dashboard.record(
+                self.step_count, loss, examples=labels.shape[0]
+            )
+
+    def eval_auc(self, batch_fn: BatchFn, num_batches: int) -> float:
+        scores, labels_all = [], []
+        for _ in range(num_batches):
+            keys, labels = batch_fn()
+            slots, inverse, _n = localize_to_slots(
+                keys, self.localizer, min_bucket=self.min_bucket
+            )
+            logits = linear.eval_logits(
+                self.table.value,
+                self.table.state,
+                self.bias,
+                self.bias_state,
+                jnp.asarray(slots),
+                jnp.asarray(inverse),
+                labels.shape[0],
+                self.optimizer,
+            )
+            scores.append(np.asarray(logits))
+            labels_all.append(labels)
+        return metrics_lib.auc(np.concatenate(labels_all), np.concatenate(scores))
+
+
+class AsyncLRLearner:
+    """Multi-worker PS loop over the Van with BSP/SSP/ASP gating.
+
+    Each worker thread: ``wait_turn -> pull(w) -> grad -> push(g) -> advance``.
+    Under ASP pushes from stale pulls interleave freely; under BSP the vector
+    clock enforces lockstep — same mechanism, same code path, mirroring the
+    reference's single DAG mechanism for all three modes.
+    """
+
+    def __init__(
+        self,
+        workers: list[KVWorker],
+        consistency: ConsistencyConfig,
+        *,
+        table: str = "w",
+        dashboard: Optional[metrics_lib.Dashboard] = None,
+    ) -> None:
+        self.workers = workers
+        self.controller = ConsistencyController(consistency, len(workers))
+        self.table = table
+        self.dashboard = dashboard or metrics_lib.Dashboard(print_every=0)
+        self._lock = threading.Lock()
+        self._losses: list[float] = []
+
+    def run(
+        self,
+        batch_fns: list[BatchFn],
+        steps_per_worker: int,
+        *,
+        timeout: float = 60.0,
+    ) -> list[float]:
+        """Run all workers to completion; returns per-iteration mean losses."""
+        threads = [
+            threading.Thread(
+                target=self._worker_loop,
+                args=(w, batch_fns[i], i, steps_per_worker, timeout),
+                name=f"sgd-worker-{i}",
+            )
+            for i, w in enumerate(self.workers)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return list(self._losses)
+
+    def _worker_loop(
+        self,
+        kv: KVWorker,
+        batch_fn: BatchFn,
+        index: int,
+        steps: int,
+        timeout: float,
+    ) -> None:
+        for t in range(steps):
+            if not self.controller.wait_turn(index, t, timeout=timeout):
+                raise TimeoutError(f"worker {index} stalled at iter {t} (SSP bound)")
+            keys, labels = batch_fn()
+            w_pos = kv.pull_sync(self.table, keys, timeout=timeout)
+            g, _gb, loss = linear.grad_rows(
+                jnp.asarray(w_pos), jnp.asarray(labels)
+            )
+            push_ts = kv.push(self.table, keys, np.asarray(g) / labels.shape[0])
+            kv.wait(push_ts, timeout=timeout)
+            self.controller.finish_iteration(index)
+            with self._lock:
+                self._losses.append(float(loss))
+                self.dashboard.record(
+                    len(self._losses), float(loss), examples=labels.shape[0]
+                )

@@ -1,0 +1,133 @@
+"""Sparse logistic regression — the reference's flagship linear method.
+
+(Reference: ``src/app/linear_method/`` — logit loss, L1/L2 penalties, AdaGrad
+async SGD workers [U]; BASELINE config #1: Criteo sparse LR.)
+
+Two execution paths over the same math:
+
+- :func:`grad_rows` — the *Van path*: the worker pulls per-position weights,
+  computes per-position gradient values, pushes them back (classic PS loop).
+- :func:`fused_train_step` — the *single-device fast path*: pull (gather),
+  loss/grad, duplicate pre-combine, optimizer apply, and scatter-back compiled
+  into ONE XLA program over the HBM-resident table; buffers donated.  This is
+  what the north-star examples/sec/chip metric measures, and the body that
+  ``parallel/`` later wraps in shard_map (psum of combined grads over the DP
+  axis before the apply == NCCL-pre-reduction replacement).
+
+With one-hot categorical features the per-example logit is the sum of the
+weights at the example's keys plus bias, and d(loss)/d(w_k) = (p - y) for
+each position holding key k.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from parameter_server_tpu.kv.optim import ServerOptimizer
+from parameter_server_tpu.ops import scatter
+
+
+def predict_logits(w_pos: jax.Array, bias: jax.Array) -> jax.Array:
+    """Per-example logits from per-position weights ``[B, nnz]``."""
+    return jnp.sum(w_pos, axis=-1) + bias
+
+
+def logloss(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Mean binary cross-entropy from logits (numerically stable)."""
+    return jnp.mean(
+        jnp.maximum(logits, 0) - logits * labels + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    )
+
+
+def grad_rows(
+    w_pos: jax.Array, labels: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Van-path worker compute: per-position gradient values.
+
+    Returns ``(per_position_grads [B, nnz], bias_grad [], loss [])``.
+    """
+    logits = predict_logits(w_pos, 0.0)
+    p = jax.nn.sigmoid(logits)
+    residual = p - labels  # [B]
+    g = jnp.broadcast_to(residual[:, None], w_pos.shape)
+    return g, jnp.mean(residual), logloss(logits, labels)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("optimizer", "num_rows"),
+    donate_argnums=(0, 1, 2, 3),
+)
+def fused_train_step(
+    value: jax.Array,
+    state: Dict[str, jax.Array],
+    bias: jax.Array,
+    bias_state: Dict[str, jax.Array],
+    ids: jax.Array,
+    inverse: jax.Array,
+    labels: jax.Array,
+    optimizer: ServerOptimizer,
+    num_rows: int,
+):
+    """One full LR step on the device-resident table.
+
+    Args:
+      value/state: the table arrays (donated, updated in place).
+      bias/bias_state: scalar bias row ``[1, 1]`` and its optimizer state.
+      ids: unique row slots ``[num_rows]`` (bucket-padded, pads -> trash row).
+      inverse: position -> slot-row map ``[B * nnz]``.
+      labels: ``[B]``.
+
+    Returns ``(value, state, bias, bias_state, loss)``.
+    """
+    batch = labels.shape[0]
+    w_rows = optimizer.pull_weights(
+        scatter.gather_rows(value, ids),
+        {k: scatter.gather_rows(v, ids) for k, v in state.items()},
+    )  # [num_rows, 1]
+    w_pos = w_rows[inverse, 0].reshape(batch, -1)  # [B, nnz]
+    # bias goes through the same lazy-weight transform (FTRL stores z here)
+    bias_w = optimizer.pull_weights(bias, bias_state)
+    logits = predict_logits(w_pos, bias_w[0, 0])
+    loss = logloss(logits, labels)
+    residual = (jax.nn.sigmoid(logits) - labels) / batch  # mean-loss scaling
+    g_pos = jnp.broadcast_to(residual[:, None], w_pos.shape).reshape(-1, 1)
+    combined = scatter.segment_combine(g_pos, inverse, num_rows)  # [num_rows, 1]
+    # optimizer apply on touched rows, scatter back
+    v_rows = scatter.gather_rows(value, ids)
+    s_rows = {k: scatter.gather_rows(v, ids) for k, v in state.items()}
+    new_v, new_s = optimizer.apply(v_rows, s_rows, combined)
+    value = scatter.scatter_update_rows_xla(value, ids, new_v)
+    state = {k: scatter.scatter_update_rows_xla(state[k], ids, new_s[k]) for k in state}
+    # re-zero the trash row (last): PAD_KEY positions route gradients there
+    fills = optimizer.state_shapes()
+    value = value.at[-1].set(0.0)
+    state = {k: state[k].at[-1].set(fills[k]) for k in state}
+    # bias via the same optimizer rule on its 1x1 "table"
+    g_bias = jnp.sum(residual)[None, None]
+    new_b, new_bs = optimizer.apply(bias, bias_state, g_bias)
+    return value, state, new_b, new_bs, loss
+
+
+def eval_logits(
+    value: jax.Array,
+    state: Dict[str, jax.Array],
+    bias: jax.Array,
+    bias_state: Dict[str, jax.Array],
+    ids: jax.Array,
+    inverse: jax.Array,
+    batch: int,
+    optimizer: ServerOptimizer,
+) -> jax.Array:
+    """Forward-only logits for evaluation batches."""
+    w_rows = optimizer.pull_weights(
+        scatter.gather_rows(value, ids),
+        {k: scatter.gather_rows(v, ids) for k, v in state.items()},
+    )
+    w_pos = w_rows[inverse, 0].reshape(batch, -1)
+    bias_w = optimizer.pull_weights(bias, bias_state)
+    return predict_logits(w_pos, bias_w[0, 0])

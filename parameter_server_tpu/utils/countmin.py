@@ -15,18 +15,7 @@ from __future__ import annotations
 
 import numpy as np
 
-_MIX_MUL = np.uint64(0xFF51AFD7ED558CCD)
-_MIX_MUL2 = np.uint64(0xC4CEB9FE1A85EC53)
-
-
-def _mix64(x: np.ndarray, seed: np.uint64) -> np.ndarray:
-    """splitmix64-style finalizer; vectorized over uint64 arrays."""
-    with np.errstate(over="ignore"):
-        x = (x ^ seed) * _MIX_MUL
-        x ^= x >> np.uint64(33)
-        x *= _MIX_MUL2
-        x ^= x >> np.uint64(33)
-    return x
+from parameter_server_tpu.utils.keys import mix64 as _mix64
 
 
 class CountMin:

@@ -32,6 +32,20 @@ import numpy as np
 #: the hot path.
 PAD_KEY = np.uint64(0xFFFFFFFFFFFFFFFF)
 
+_MIX_MUL = np.uint64(0xFF51AFD7ED558CCD)
+_MIX_MUL2 = np.uint64(0xC4CEB9FE1A85EC53)
+
+
+def mix64(x: np.ndarray, seed: int | np.uint64 = 0) -> np.ndarray:
+    """splitmix64-style avalanche mix, vectorized over uint64 arrays."""
+    x = np.asarray(x, dtype=np.uint64)
+    with np.errstate(over="ignore"):
+        x = (x ^ np.uint64(seed)) * _MIX_MUL
+        x ^= x >> np.uint64(33)
+        x *= _MIX_MUL2
+        x ^= x >> np.uint64(33)
+    return x
+
 
 def bucket_size(n: int, *, min_bucket: int = 256) -> int:
     """Round ``n`` up to the next power-of-two bucket (>= min_bucket).
@@ -107,6 +121,59 @@ def even_key_ranges(num_servers: int, key_space: int = 2**64) -> np.ndarray:
     return np.array(bounds_py, dtype=np.uint64)
 
 
+def localize_to_slots(
+    keys: np.ndarray, localizer: "Localizer", *, min_bucket: int = 256
+) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Full host-side key pipeline: raw keys -> unique row slots + inverse.
+
+    Composes :func:`localize_batch` with :meth:`Localizer.assign` and then
+    re-uniquifies the *slots* (after vocabulary overflow two distinct keys may
+    hash-share a slot; the device requires unique ids for the scatter fast
+    path).  Returns ``(slots, inverse, n)``: sorted unique slot ids padded to
+    a power-of-two bucket (pads point at the trash row ``capacity``),
+    position->slot-row inverse, and the true unique-slot count.
+    """
+    uniq, key_inv, _ = localize_batch(
+        keys, pad_to_bucket=False, min_bucket=min_bucket
+    )
+    raw_slots = localizer.assign(uniq)
+    uniq_slots, slot_inv = np.unique(raw_slots, return_inverse=True)
+    n = int(uniq_slots.shape[0])
+    cap = bucket_size(n, min_bucket=min_bucket)
+    if cap > n:
+        uniq_slots = np.concatenate(
+            [uniq_slots, np.full(cap - n, localizer.capacity, dtype=uniq_slots.dtype)]
+        )
+    inverse = slot_inv[key_inv].astype(np.int32)
+    return uniq_slots.astype(np.int32, copy=False), inverse, n
+
+
+class HashLocalizer:
+    """Stateless deterministic key -> slot mapping (the hashing trick).
+
+    Multi-worker training requires every worker to map a global key to the
+    *same* table row without coordination; a deterministic hash provides that
+    (at the cost of collisions, which :func:`localize_to_slots` tolerates by
+    re-uniquifying slots).  This is the standard large-vocabulary CTR/DLRM
+    scheme and the multi-worker counterpart of :class:`Localizer`.
+    """
+
+    def __init__(self, capacity: int, seed: int = 0):
+        if not (0 < capacity < 2**31 - 1):
+            raise ValueError(
+                "capacity must fit int32 row ids (shard billion-row tables "
+                "across servers / mesh axes instead)"
+            )
+        self.capacity = capacity
+        self.seed = seed
+        self.overflowed = True  # collisions always possible
+
+    def assign(self, unique_keys: np.ndarray) -> np.ndarray:
+        keys = np.asarray(unique_keys, dtype=np.uint64)
+        slots = (mix64(keys, self.seed) % np.uint64(self.capacity)).astype(np.int32)
+        return np.where(keys == PAD_KEY, np.int32(self.capacity), slots)
+
+
 class Localizer:
     """Persistent global-key -> stable dense row-slot mapping.
 
@@ -123,8 +190,8 @@ class Localizer:
     """
 
     def __init__(self, capacity: int):
-        if capacity <= 0:
-            raise ValueError("capacity must be positive")
+        if not (0 < capacity < 2**31 - 1):
+            raise ValueError("capacity must be positive and fit int32 row ids")
         self.capacity = capacity
         self._map: dict[int, int] = {}
         self._overflowed = False

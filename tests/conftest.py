@@ -3,14 +3,34 @@
 Multi-device behavior (sharding, collectives, psum-before-push) is tested on
 one host by faking 8 CPU devices, mirroring how the reference tests multi-node
 via N processes over loopback ZMQ (SURVEY.md §4).  Must run before jax import.
+
+The dev image injects an experimental TPU PJRT plugin ("axon") into every
+interpreter via sitecustomize; its init contacts a device-relay service and
+can block CPU-only test runs (e.g. when a crashed process holds the single
+TPU claim).  Tests never need the real chip, so the plugin registration is
+removed outright before the first jax operation.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+try:  # unregister the axon PJRT plugin factory if sitecustomize added it
+    # sitecustomize has already imported jax at interpreter boot, so the env
+    # vars above were read too late for the config defaults — force them.
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from jax._src import xla_bridge as _xb
+
+    for _name in list(getattr(_xb, "_backend_factories", {})):
+        if _name not in ("cpu", "tpu", "gpu", "cuda", "rocm"):
+            _xb._backend_factories.pop(_name, None)
+except Exception:
+    pass

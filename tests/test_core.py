@@ -1,0 +1,153 @@
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from parameter_server_tpu.config import ConsistencyConfig, ConsistencyMode
+from parameter_server_tpu.core.clock import ConsistencyController, VectorClock
+from parameter_server_tpu.core.messages import (
+    Message,
+    Task,
+    TaskKind,
+    node_role,
+    server_id,
+    worker_id,
+)
+from parameter_server_tpu.core.postoffice import Customer, Postoffice
+from parameter_server_tpu.core.van import LoopbackVan
+
+
+class EchoServer(Customer):
+    def handle_request(self, msg):
+        return msg.reply(values=[v * 2 for v in msg.values])
+
+
+def _make_pair():
+    van = LoopbackVan()
+    server_post = Postoffice("S0", van)
+    worker_post = Postoffice("W0", van)
+    server = EchoServer("echo", server_post)
+    client = Customer("echo", worker_post)
+    return van, server, client
+
+
+def test_node_ids():
+    assert node_role("H").value == "scheduler"
+    assert node_role(server_id(3)).value == "server"
+    assert node_role(worker_id(0)).value == "worker"
+    with pytest.raises(ValueError):
+        node_role("X9")
+
+
+def test_request_response_roundtrip():
+    van, server, client = _make_pair()
+    try:
+        msg = Message(
+            task=Task(TaskKind.PUSH, "echo"),
+            recver="S0",
+            values=[np.array([1.0, 2.0])],
+        )
+        ts = client.submit([msg])
+        assert client.wait(ts, timeout=5)
+        (resp,) = client.responses(ts)
+        np.testing.assert_allclose(resp.values[0], [2.0, 4.0])
+    finally:
+        van.close()
+
+
+def test_multiple_outstanding_and_callbacks():
+    van, server, client = _make_pair()
+    try:
+        fired = []
+        tss = []
+        for i in range(10):
+            msg = Message(
+                task=Task(TaskKind.PUSH, "echo"),
+                recver="S0",
+                values=[np.array([float(i)])],
+            )
+            tss.append(client.submit([msg], callback=lambda r, i=i: fired.append(i)))
+        for ts in tss:
+            assert client.wait(ts, timeout=5)
+        deadline = time.time() + 5
+        while len(fired) < 10 and time.time() < deadline:
+            time.sleep(0.01)
+        assert sorted(fired) == list(range(10))
+        # timestamps strictly increasing
+        assert tss == sorted(tss) and len(set(tss)) == 10
+    finally:
+        van.close()
+
+
+def test_dead_receiver_does_not_hang_wait():
+    van, server, client = _make_pair()
+    try:
+        van.disconnect("S0")
+        msg = Message(task=Task(TaskKind.PUSH, "echo"), recver="S0")
+        ts = client.submit([msg])
+        assert client.wait(ts, timeout=5)  # completes (with zero responses)
+        assert client.responses(ts) == []
+        assert van.dropped_messages == 1
+    finally:
+        van.close()
+
+
+def test_vector_clock():
+    vc = VectorClock(3)
+    assert vc.min() == 0
+    vc.advance(0)
+    vc.advance(0)
+    vc.advance(1)
+    assert vc.min() == 0 and vc.snapshot() == [2, 1, 0]
+    done = []
+    t = threading.Thread(target=lambda: done.append(vc.wait_until_min(1, timeout=5)))
+    t.start()
+    vc.advance(2)
+    t.join(timeout=5)
+    assert done == [True]
+
+
+@pytest.mark.parametrize(
+    "mode,delay,expect_block",
+    [
+        (ConsistencyMode.BSP, 0, True),
+        (ConsistencyMode.SSP, 2, True),
+        (ConsistencyMode.ASP, 0, False),
+    ],
+)
+def test_consistency_gating(mode, delay, expect_block):
+    cfg = ConsistencyConfig(mode=mode, max_delay=delay)
+    ctl = ConsistencyController(cfg, num_workers=2)
+    lead = delay if mode == ConsistencyMode.SSP else 0
+    # worker 0 runs ahead: can start iterations 0..lead freely
+    for t in range(lead + 1):
+        assert ctl.wait_turn(0, t, timeout=0.1)
+        ctl.finish_iteration(0)
+    # next iteration must block (BSP/SSP) until worker 1 advances
+    blocked = not ctl.wait_turn(0, lead + 1, timeout=0.1)
+    assert blocked == expect_block
+    if expect_block:
+        ctl.finish_iteration(1)
+        assert ctl.wait_turn(0, lead + 1, timeout=5)
+
+
+def test_ssp_dead_worker_excluded():
+    cfg = ConsistencyConfig(mode=ConsistencyMode.SSP, max_delay=1)
+    ctl = ConsistencyController(cfg, num_workers=2)
+    ctl.finish_iteration(0)
+    ctl.finish_iteration(0)
+    assert not ctl.wait_turn(0, 2, timeout=0.1)  # blocked on worker 1
+    ctl.mark_dead(1)
+    assert ctl.wait_turn(0, 2, timeout=5)  # dead worker no longer gates
+
+
+def test_wait_time_for_matches_reference_dag():
+    bsp = ConsistencyController(ConsistencyConfig(ConsistencyMode.BSP), 1)
+    ssp = ConsistencyController(
+        ConsistencyConfig(ConsistencyMode.SSP, max_delay=3), 1
+    )
+    asp = ConsistencyController(ConsistencyConfig(ConsistencyMode.ASP), 1)
+    assert bsp.wait_time_for(5) == 4  # depend on all prior
+    assert ssp.wait_time_for(5) == 1  # t - 1 - tau
+    assert asp.wait_time_for(5) == -1  # no deps

@@ -1,0 +1,200 @@
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from parameter_server_tpu.config import OptimizerConfig, TableConfig
+from parameter_server_tpu.core.postoffice import Postoffice
+from parameter_server_tpu.core.van import LoopbackVan
+from parameter_server_tpu.kv.optim import make_optimizer
+from parameter_server_tpu.kv.partition import RangePartition
+from parameter_server_tpu.kv.server import KVServer
+from parameter_server_tpu.kv.table import KVTable
+from parameter_server_tpu.kv.worker import KVWorker
+from parameter_server_tpu.utils.keys import HashLocalizer
+
+
+def test_unknown_optimizer():
+    with pytest.raises(ValueError, match="unknown optimizer"):
+        make_optimizer(OptimizerConfig(kind="lbfgs"))
+
+
+def test_sgd_apply():
+    opt = make_optimizer(OptimizerConfig(kind="sgd", learning_rate=0.5, l2=0.1))
+    v = jnp.ones((2, 3))
+    g = jnp.full((2, 3), 2.0)
+    new, _ = opt.apply(v, {}, g)
+    np.testing.assert_allclose(np.asarray(new), 1 - 0.5 * (2 + 0.1), rtol=1e-6)
+
+
+def test_adagrad_apply_matches_numpy():
+    opt = make_optimizer(OptimizerConfig(kind="adagrad", learning_rate=0.1, eps=1e-8))
+    v = jnp.zeros((4, 1))
+    state = {"sum_sq": jnp.zeros((4, 1))}
+    g = jnp.array([[1.0], [2.0], [0.0], [-1.0]])
+    new, ns = opt.apply(v, state, g)
+    gn = np.asarray(g)
+    expect = -0.1 * gn / (np.abs(gn) + 1e-8)
+    expect[2] = 0.0
+    np.testing.assert_allclose(np.asarray(new), expect, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(ns["sum_sq"]), gn * gn)
+
+
+def test_adam_per_row_step():
+    opt = make_optimizer(OptimizerConfig(kind="adam", learning_rate=0.01))
+    v = jnp.zeros((2, 1))
+    state = {k: jnp.zeros((2, 1)) for k in ("m", "v", "t")}
+    g = jnp.array([[1.0], [0.0]])
+    new, ns = opt.apply(v, state, g)
+    # row 0 took a step; first adam step size ~= lr
+    assert abs(float(new[0, 0]) + 0.01) < 1e-3
+    assert float(ns["t"][0, 0]) == 1.0 and float(ns["t"][1, 0]) == 1.0
+
+
+def test_ftrl_lazy_weights_and_sparsity():
+    cfg = OptimizerConfig(kind="ftrl", l1=1.0, ftrl_alpha=0.1)
+    opt = make_optimizer(cfg)
+    z = jnp.array([[0.5], [-5.0]])
+    state = {"n": jnp.array([[1.0], [4.0]])}
+    w = opt.pull_weights(z, state)
+    assert float(w[0, 0]) == 0.0  # |z| <= l1 -> exactly zero (L1 sparsity)
+    expect = -(-5.0 + 1.0) / ((1.0 + 2.0) / 0.1)
+    np.testing.assert_allclose(float(w[1, 0]), expect, rtol=1e-5)
+
+
+def test_ftrl_learns_sign():
+    """Pushing constant positive gradients drives the weight negative."""
+    cfg = OptimizerConfig(kind="ftrl", l1=0.01, ftrl_alpha=0.5)
+    t = KVTable(TableConfig(name="w", rows=8, dim=1, optimizer=cfg))
+    ids = jnp.arange(8, dtype=jnp.int32)
+    for _ in range(20):
+        t.push(ids, jnp.ones((8, 1)))
+    w = np.asarray(t.pull(ids))
+    assert np.all(w < 0)
+
+
+def test_table_push_pull_shadow():
+    cfg = TableConfig(
+        name="emb",
+        rows=64,
+        dim=8,
+        optimizer=OptimizerConfig(kind="sgd", learning_rate=1.0),
+    )
+    t = KVTable(cfg)
+    rng = np.random.default_rng(0)
+    shadow = np.zeros((65, 8), dtype=np.float64)
+    for _ in range(5):
+        ids = np.sort(rng.permutation(64)[:16]).astype(np.int32)
+        grads = rng.normal(size=(16, 8)).astype(np.float32)
+        t.push(jnp.asarray(ids), jnp.asarray(grads))
+        shadow[ids] -= grads
+    np.testing.assert_allclose(
+        np.asarray(t.pull(jnp.arange(64, dtype=jnp.int32))),
+        shadow[:64],
+        rtol=1e-5,
+        atol=1e-6,
+    )
+
+
+def test_table_init_scale():
+    cfg = TableConfig(name="emb", rows=100, dim=16, init_scale=0.1)
+    t = KVTable(cfg)
+    vals = np.asarray(t.value)
+    assert 0.01 < vals[:100].std() < 0.3
+    np.testing.assert_allclose(vals[100], 0.0)  # trash row zeroed
+
+
+def test_trash_row_stays_zero_under_pad_gradients():
+    """PAD_KEY positions in variable-nnz batches must not poison the trash row."""
+    from parameter_server_tpu.utils.keys import PAD_KEY, HashLocalizer, localize_to_slots
+
+    cfg = TableConfig(
+        name="w", rows=64, dim=4,
+        optimizer=OptimizerConfig(kind="adagrad", learning_rate=0.5),
+    )
+    t = KVTable(cfg)
+    loc = HashLocalizer(64)
+    keys = np.array([5, 9, PAD_KEY, PAD_KEY], dtype=np.uint64)
+    slots, inverse, n = localize_to_slots(keys, loc, min_bucket=8)
+    grads = np.ones((4, 4), dtype=np.float32)  # pads carry REAL grads
+    combined = t.combine(jnp.asarray(inverse), jnp.asarray(grads), slots.shape[0])
+    t.push(jnp.asarray(slots), combined)
+    np.testing.assert_allclose(np.asarray(t.value)[64], 0.0)  # trash reset
+    np.testing.assert_allclose(np.asarray(t.state["sum_sq"])[64], 0.0)
+    # pulls of pad positions are exactly zero
+    pulled = np.asarray(t.pull(jnp.asarray(slots)))
+    trash_positions = slots == 64
+    np.testing.assert_allclose(pulled[trash_positions], 0.0)
+
+
+def test_hash_localizer_rejects_giant_capacity():
+    from parameter_server_tpu.utils.keys import HashLocalizer
+
+    with pytest.raises(ValueError, match="int32"):
+        HashLocalizer(3_000_000_000)
+
+
+def test_range_partition():
+    p = RangePartition(rows=10, num_servers=3)
+    np.testing.assert_array_equal(p.offsets, [0, 4, 7, 10])
+    ids = np.array([0, 3, 4, 9, 10], dtype=np.int32)  # 10 == trash
+    parts = list(p.slice_ids(ids))
+    assert [seg for _, seg, _ in parts] == [slice(0, 2), slice(2, 3), slice(3, 5)]
+    np.testing.assert_array_equal(parts[0][2], [0, 3])
+    np.testing.assert_array_equal(parts[1][2], [0])
+    np.testing.assert_array_equal(parts[2][2], [2, 3])  # local trash == 3
+
+
+def test_range_partition_empty_segments():
+    p = RangePartition(rows=100, num_servers=4)
+    parts = list(p.slice_ids(np.array([0, 1], dtype=np.int32)))
+    assert len(parts) == 4
+    assert parts[1][2].size == 0 and parts[3][2].size == 0
+
+
+@pytest.fixture
+def cluster():
+    van = LoopbackVan()
+    cfgs = {
+        "w": TableConfig(
+            name="w",
+            rows=1000,
+            dim=4,
+            optimizer=OptimizerConfig(kind="sgd", learning_rate=1.0),
+        )
+    }
+    servers = [
+        KVServer(Postoffice(f"S{i}", van), cfgs, i, 2) for i in range(2)
+    ]
+    worker = KVWorker(Postoffice("W0", van), cfgs, 2, min_bucket=16)
+    yield van, servers, worker, cfgs
+    van.close()
+
+
+def test_worker_server_roundtrip(cluster):
+    van, servers, worker, cfgs = cluster
+    keys = np.array([17, 999999, 17, 42], dtype=np.uint64)
+    # initial pull: zeros
+    w0 = worker.pull_sync("w", keys, timeout=10)
+    assert w0.shape == (4, 4)
+    np.testing.assert_allclose(w0, 0.0)
+    # push gradient 1.0 everywhere; key 17 appears twice -> combined grad 2
+    ts = worker.push("w", keys, np.ones((4, 4), dtype=np.float32))
+    assert worker.wait(ts, timeout=10)
+    w1 = worker.pull_sync("w", keys, timeout=10)
+    np.testing.assert_allclose(w1[0], -2.0, rtol=1e-6)  # sgd lr=1: w -= g
+    np.testing.assert_allclose(w1[2], -2.0, rtol=1e-6)
+    np.testing.assert_allclose(w1[1], -1.0, rtol=1e-6)
+    np.testing.assert_allclose(w1[3], -1.0, rtol=1e-6)
+    assert servers[0].pushes + servers[1].pushes == 2
+
+
+def test_worker_multi_worker_consistency(cluster):
+    """Two workers sharing HashLocalizers see each other's pushes."""
+    van, servers, worker, cfgs = cluster
+    worker2 = KVWorker(Postoffice("W1", van), cfgs, 2, min_bucket=16)
+    keys = np.array([123456789], dtype=np.uint64)
+    ts = worker.push("w", keys, np.full((1, 4), 3.0, dtype=np.float32))
+    worker.wait(ts, timeout=10)
+    w = worker2.pull_sync("w", keys, timeout=10)
+    np.testing.assert_allclose(w[0], -3.0, rtol=1e-6)
