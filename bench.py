@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Headline benchmark: Criteo-style sparse LR, examples/sec/chip.
+
+The north-star metric (BASELINE.json [V]): single-chip async-SGD sparse
+logistic regression throughput.  Runs the dense-apply fused step (one XLA
+program per step, donated HBM table) with async dispatch so host batch
+preparation overlaps device execution.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+``vs_baseline`` is relative to the anchor recorded in BASELINE.md (the first
+TPU measurement of this same benchmark — the reference repo's own numbers are
+unrecoverable, see BASELINE.md).  Until an anchor exists, vs_baseline == 1.0.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+#: First recorded v5e single-chip measurement of this benchmark (BASELINE.md
+#: "first build milestone" anchor).  None until measured on real hardware;
+#: then vs_baseline == measured/anchor.
+ANCHOR_EXAMPLES_PER_SEC = None
+
+ROWS = 1 << 22  # 4.2M-row weight table (fits any chip; Criteo-1TB hashed)
+NNZ = 39  # criteo categorical slots
+BATCH = 16384
+WARMUP_STEPS = 8
+MEASURE_STEPS = 50
+
+
+def main() -> None:
+    from parameter_server_tpu.config import OptimizerConfig, TableConfig
+    from parameter_server_tpu.data.synthetic import SyntheticCTR
+    from parameter_server_tpu.learner.sgd import LocalLRTrainer
+
+    import jax
+
+    cfg = TableConfig(
+        name="w",
+        rows=ROWS,
+        dim=1,
+        optimizer=OptimizerConfig(kind="adagrad", learning_rate=0.05),
+    )
+    trainer = LocalLRTrainer(cfg, mode="dense")
+    data = SyntheticCTR(
+        key_space=1 << 26, nnz=NNZ, batch_size=BATCH, seed=0, informative=0.1
+    )
+    # pre-generate host batches so the RNG isn't inside the timed loop;
+    # hashing (localizer.assign) stays in the loop — it is part of the
+    # real per-batch host pipeline.
+    batches = [data.next_batch() for _ in range(WARMUP_STEPS + MEASURE_STEPS)]
+
+    for keys, labels in batches[:WARMUP_STEPS]:
+        trainer.step_async(keys, labels)
+    jax.block_until_ready(trainer.table.value)
+
+    t0 = time.perf_counter()
+    loss = None
+    for keys, labels in batches[WARMUP_STEPS:]:
+        loss = trainer.step_async(keys, labels)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    examples_per_sec = MEASURE_STEPS * BATCH / dt
+    vs = (
+        examples_per_sec / ANCHOR_EXAMPLES_PER_SEC
+        if ANCHOR_EXAMPLES_PER_SEC
+        else 1.0
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "criteo_sparse_lr_async_sgd_throughput",
+                "value": round(examples_per_sec, 1),
+                "unit": "examples/sec/chip",
+                "vs_baseline": round(vs, 4),
+            }
+        )
+    )
+    # diagnostics on stderr so stdout stays one JSON line
+    print(
+        f"backend={jax.default_backend()} steps={MEASURE_STEPS} batch={BATCH} "
+        f"nnz={NNZ} rows={ROWS} dt={dt:.3f}s final_loss={float(loss):.4f}",
+        file=sys.stderr,
+    )
+
+
+if __name__ == "__main__":
+    main()

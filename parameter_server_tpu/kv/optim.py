@@ -34,6 +34,10 @@ class ServerOptimizer:
     """Interface: init per-row state, apply updates, derive pull weights."""
 
     name = "base"
+    #: True iff apply(value, state, 0) == (value, state) when l1 == l2 == 0.
+    #: Required by the dense-apply paths (full-table elementwise update);
+    #: rules with decaying state (Adam) must set False.
+    g0_stable = False
 
     def __init__(self, cfg: OptimizerConfig) -> None:
         self.cfg = cfg
@@ -50,6 +54,7 @@ class ServerOptimizer:
 
 
 class SGD(ServerOptimizer):
+    g0_stable = True
     name = "sgd"
 
     def apply(self, value, state, grad):
@@ -62,6 +67,7 @@ class AdaGrad(ServerOptimizer):
     """AdaGrad with optional L1 truncation — the reference's async-SGD server
     rule for sparse LR (``src/app/linear_method/async_sgd.h`` [U])."""
 
+    g0_stable = True
     name = "adagrad"
 
     def state_shapes(self):
@@ -112,6 +118,7 @@ class FTRL(ServerOptimizer):
     Matches the reference FTRLEntry update functor semantics [U].
     """
 
+    g0_stable = True
     name = "ftrl"
 
     def state_shapes(self):
@@ -150,3 +157,18 @@ def make_optimizer(cfg: OptimizerConfig) -> ServerOptimizer:
         raise ValueError(
             f"unknown optimizer {cfg.kind!r}; have {sorted(_REGISTRY)}"
         ) from None
+
+
+def require_dense_apply(cfg: OptimizerConfig) -> None:
+    """Validate that ``cfg`` is safe for the dense-apply (full-table) paths.
+
+    Dense apply touches every row each step, so the update must be exactly
+    zero at g=0: no penalties, and a ``g0_stable`` rule.
+    """
+    opt = make_optimizer(cfg)
+    if cfg.l1 != 0.0 or cfg.l2 != 0.0 or not opt.g0_stable:
+        raise ValueError(
+            "dense-apply requires l1=l2=0 and a g0-stable optimizer "
+            f"(got kind={cfg.kind!r}, l1={cfg.l1}, l2={cfg.l2}); "
+            "use the row-apply path instead"
+        )

@@ -30,7 +30,7 @@ from parameter_server_tpu.config import (
     TableConfig,
 )
 from parameter_server_tpu.core.clock import ConsistencyController
-from parameter_server_tpu.kv.optim import make_optimizer
+from parameter_server_tpu.kv.optim import make_optimizer, require_dense_apply
 from parameter_server_tpu.kv.table import KVTable
 from parameter_server_tpu.kv.worker import KVWorker
 from parameter_server_tpu.models import linear
@@ -50,9 +50,18 @@ class LocalLRTrainer:
         *,
         min_bucket: int = 1024,
         dashboard: Optional[metrics_lib.Dashboard] = None,
+        mode: str = "rows",
     ) -> None:
+        """``mode="rows"``: bucketed-unique gather/apply/scatter (general).
+        ``mode="dense"``: per-position hashed slots + full-table apply — no
+        host dedup; requires l1 == l2 == 0 and a g=0-stable optimizer."""
         if table_cfg.dim != 1:
             raise ValueError("LR weight table must have dim=1")
+        if mode not in ("rows", "dense"):
+            raise ValueError(f"unknown mode {mode!r}")
+        if mode == "dense":
+            require_dense_apply(table_cfg.optimizer)
+        self.mode = mode
         self.cfg = table_cfg
         self.table = KVTable(table_cfg)
         self.optimizer = self.table.optimizer
@@ -67,23 +76,73 @@ class LocalLRTrainer:
         self.step_count = 0
 
     def step(self, keys: np.ndarray, labels: np.ndarray) -> float:
-        slots, inverse, _n = localize_to_slots(
-            keys, self.localizer, min_bucket=self.min_bucket
-        )
         t = self.table
-        t.value, t.state, self.bias, self.bias_state, loss = linear.fused_train_step(
+        if self.mode == "dense":
+            slots_pos = self.localizer.assign(keys)  # [B, nnz], no dedup
+            (
+                t.value,
+                t.state,
+                self.bias,
+                self.bias_state,
+                loss,
+            ) = linear.dense_fused_train_step(
+                t.value,
+                t.state,
+                self.bias,
+                self.bias_state,
+                jnp.asarray(slots_pos),
+                jnp.asarray(labels),
+                self.optimizer,
+                self.cfg.rows,
+            )
+        else:
+            slots, inverse, _n = localize_to_slots(
+                keys, self.localizer, min_bucket=self.min_bucket
+            )
+            t.value, t.state, self.bias, self.bias_state, loss = (
+                linear.fused_train_step(
+                    t.value,
+                    t.state,
+                    self.bias,
+                    self.bias_state,
+                    jnp.asarray(slots),
+                    jnp.asarray(inverse),
+                    jnp.asarray(labels),
+                    self.optimizer,
+                    slots.shape[0],
+                )
+            )
+        self.step_count += 1
+        return float(loss)
+
+    def step_async(self, keys: np.ndarray, labels: np.ndarray) -> jax.Array:
+        """Dense-mode step without host sync; returns the device loss.
+
+        Lets the host race ahead preparing batches while the device queue
+        drains (the PS pipelining analogue for the single-chip path).
+        """
+        if self.mode != "dense":
+            raise ValueError("step_async requires mode='dense'")
+        t = self.table
+        slots_pos = self.localizer.assign(keys)
+        (
             t.value,
             t.state,
             self.bias,
             self.bias_state,
-            jnp.asarray(slots),
-            jnp.asarray(inverse),
+            loss,
+        ) = linear.dense_fused_train_step(
+            t.value,
+            t.state,
+            self.bias,
+            self.bias_state,
+            jnp.asarray(slots_pos),
             jnp.asarray(labels),
             self.optimizer,
-            slots.shape[0],
+            self.cfg.rows,
         )
         self.step_count += 1
-        return float(loss)
+        return loss
 
     def train(self, batch_fn: BatchFn, num_steps: int) -> None:
         for _ in range(num_steps):

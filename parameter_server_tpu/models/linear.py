@@ -113,6 +113,60 @@ def fused_train_step(
     return value, state, new_b, new_bs, loss
 
 
+def dense_fused_impl(
+    value: jax.Array,
+    state: Dict[str, jax.Array],
+    bias: jax.Array,
+    bias_state: Dict[str, jax.Array],
+    slots_pos: jax.Array,
+    labels: jax.Array,
+    optimizer: ServerOptimizer,
+    trash_row: int = -1,
+):
+    """Dense-apply LR step: no host dedup, no row gather/scatter of updates.
+
+    The TPU-native formulation of the server update: per-position hashed row
+    slots ``[B, nnz]`` index the table directly; duplicate slots are combined
+    by the scatter-add into a full-size gradient buffer, and the optimizer
+    applies *elementwise over the whole table*.  For rows with zero gradient
+    the update is exactly zero under SGD/AdaGrad/FTRL (their state updates
+    are also zero at g=0), so this matches the sparse row-apply semantics
+    while avoiding the per-batch ``np.unique`` host bottleneck entirely.
+
+    Caveats (callers must enforce): requires ``l1 == l2 == 0`` — penalties
+    make the update nonzero at g=0 rows (l2 decays every row; AdaGrad's prox
+    with sum_sq=0 would zero untouched weights) — and an optimizer whose
+    state update is zero at g=0 (true for SGD/AdaGrad/FTRL; NOT Adam, whose
+    moments decay).  Otherwise use the row-apply :func:`fused_train_step`.
+
+    HBM traffic per step is O(table size); right for tables up to a few GB
+    (Criteo LR at 2^25 rows x 4B = 128 MB -> ~0.2 ms at v5e bandwidth).
+    """
+    batch = labels.shape[0]
+    w_table = optimizer.pull_weights(value, state)  # elementwise transform
+    w_pos = w_table[slots_pos.reshape(-1), 0].reshape(batch, -1)
+    bias_w = optimizer.pull_weights(bias, bias_state)
+    logits = predict_logits(w_pos, bias_w[0, 0])
+    loss = logloss(logits, labels)
+    residual = (jax.nn.sigmoid(logits) - labels) / batch
+    g_pos = jnp.broadcast_to(residual[:, None], w_pos.shape).reshape(-1)
+    grad_buf = jnp.zeros_like(value).at[slots_pos.reshape(-1), 0].add(g_pos)
+    # drop PAD contributions; trash_row is the PAD slot of the localizer
+    # (== capacity); -1 only coincides with it for unpadded [rows+1] tables
+    grad_buf = grad_buf.at[trash_row].set(0.0)
+    value, state = optimizer.apply(value, state, grad_buf)
+    g_bias = jnp.sum(residual)[None, None]
+    new_b, new_bs = optimizer.apply(bias, bias_state, g_bias)
+    return value, state, new_b, new_bs, loss
+
+
+dense_fused_train_step = functools.partial(
+    jax.jit,
+    static_argnames=("optimizer", "trash_row"),
+    donate_argnums=(0, 1, 2, 3),
+)(dense_fused_impl)
+
+
 def eval_logits(
     value: jax.Array,
     state: Dict[str, jax.Array],

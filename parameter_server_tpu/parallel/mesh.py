@@ -1,0 +1,56 @@
+"""Device mesh construction and canonical sharding rules.
+
+The TPU replacement for the reference's process topology (SURVEY.md §2 #6):
+the scheduler's NodeAssigner key-range split becomes row-sharding of table
+arrays over the ``"model"`` mesh axis; the worker pool becomes the ``"data"``
+axis.  Gradient pre-reduction over ``"data"`` (the north star's
+NCCL-intra-node-psum replacement) is inserted by GSPMD when data-sharded
+per-position gradients reduce into model-sharded table rows.
+
+Axis conventions (extended by later milestones):
+  data    — data parallelism (batch dimension)
+  model   — table row shards / tensor parallelism
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(
+    shape: Optional[Sequence[int]] = None,
+    axis_names: Sequence[str] = (DATA_AXIS, MODEL_AXIS),
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a mesh over the available devices.
+
+    Default shape: all devices on the data axis (pure DP), model axis 1.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if shape is None:
+        shape = (len(devices),) + (1,) * (len(axis_names) - 1)
+    if int(np.prod(shape)) != len(devices):
+        raise ValueError(f"mesh shape {shape} != {len(devices)} devices")
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, tuple(axis_names))
+
+
+def table_sharding(mesh: Mesh) -> NamedSharding:
+    """Row-sharded table over the model axis (NodeAssigner key ranges)."""
+    return NamedSharding(mesh, P(MODEL_AXIS, None))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, ndim: int) -> NamedSharding:
+    """Leading-axis (batch) sharding over the data axis."""
+    return NamedSharding(mesh, P(DATA_AXIS, *(None,) * (ndim - 1)))

@@ -1,0 +1,98 @@
+"""SPMD LR over the virtual 8-device CPU mesh (SURVEY.md §4 sim strategy)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from parameter_server_tpu.config import OptimizerConfig, TableConfig
+from parameter_server_tpu.data.synthetic import SyntheticCTR
+from parameter_server_tpu.learner.sgd import LocalLRTrainer
+from parameter_server_tpu.parallel import mesh as mesh_lib
+from parameter_server_tpu.parallel.lr_spmd import SpmdLRTrainer
+
+
+def _cfg(rows=1 << 14, lr=0.2):
+    return TableConfig(
+        name="w",
+        rows=rows,
+        dim=1,
+        optimizer=OptimizerConfig(kind="adagrad", learning_rate=lr),
+    )
+
+
+def test_make_mesh_shapes():
+    m = mesh_lib.make_mesh()
+    assert m.shape["data"] == 8 and m.shape["model"] == 1
+    m2 = mesh_lib.make_mesh((4, 2))
+    assert m2.shape["data"] == 4 and m2.shape["model"] == 2
+    with pytest.raises(ValueError, match="devices"):
+        mesh_lib.make_mesh((3, 2))
+
+
+@pytest.mark.parametrize("shape", [(8, 1), (4, 2), (1, 8), (2, 4)])
+def test_spmd_matches_single_device(shape):
+    """The sharded step must reproduce the single-device trajectory."""
+    mesh = mesh_lib.make_mesh(shape)
+    data_a = SyntheticCTR(
+        key_space=1 << 14, nnz=8, batch_size=256, seed=3, informative=0.3
+    )
+    data_b = SyntheticCTR(
+        key_space=1 << 14, nnz=8, batch_size=256, seed=3, informative=0.3
+    )
+    spmd = SpmdLRTrainer(_cfg(), mesh)
+    local = LocalLRTrainer(_cfg(), mode="dense")
+    spmd_losses = [spmd.step(*data_a.next_batch()) for _ in range(10)]
+    local_losses = [local.step(*data_b.next_batch()) for _ in range(10)]
+    np.testing.assert_allclose(spmd_losses, local_losses, rtol=2e-4)
+    assert spmd_losses[-1] < spmd_losses[0] - 0.05
+
+
+def test_spmd_table_is_actually_sharded():
+    mesh = mesh_lib.make_mesh((2, 4))
+    spmd = SpmdLRTrainer(_cfg(rows=1 << 12), mesh)
+    shards = spmd.state.value.addressable_shards
+    assert len(shards) == 8
+    # model axis 4: each shard holds total_rows/4 rows
+    assert shards[0].data.shape[0] == spmd.total_rows // 4
+
+
+def test_spmd_rejects_penalties():
+    cfg = TableConfig(
+        name="w", rows=64, dim=1,
+        optimizer=OptimizerConfig(kind="adagrad", l1=0.1),
+    )
+    with pytest.raises(ValueError, match="l1=l2=0"):
+        SpmdLRTrainer(cfg, mesh_lib.make_mesh())
+
+
+def test_dense_local_matches_rows_mode_sgd():
+    """dense-apply and row-apply paths agree for plain SGD."""
+    cfg = TableConfig(
+        name="w", rows=1 << 12, dim=1,
+        optimizer=OptimizerConfig(kind="sgd", learning_rate=0.5),
+    )
+    da = SyntheticCTR(key_space=1 << 12, nnz=4, batch_size=128, seed=5, informative=0.3)
+    db = SyntheticCTR(key_space=1 << 12, nnz=4, batch_size=128, seed=5, informative=0.3)
+    dense = LocalLRTrainer(cfg, mode="dense")
+    rows = LocalLRTrainer(cfg, mode="rows", min_bucket=256)
+    dl = [dense.step(*da.next_batch()) for _ in range(8)]
+    rl = [rows.step(*db.next_batch()) for _ in range(8)]
+    np.testing.assert_allclose(dl, rl, rtol=1e-4)
+
+
+def test_spmd_pad_keys_do_not_poison():
+    """PAD_KEY positions under a sharded (padded) table stay inert."""
+    from parameter_server_tpu.utils.keys import PAD_KEY
+
+    mesh = mesh_lib.make_mesh((4, 2))
+    spmd = SpmdLRTrainer(_cfg(rows=1 << 12), mesh)
+    assert spmd.total_rows > (1 << 12) + 1  # padding rows exist
+    rng = np.random.default_rng(0)
+    keys = rng.integers(0, 1 << 12, size=(64, 8), dtype=np.uint64)
+    keys[:, -2:] = PAD_KEY  # variable-nnz padding
+    labels = (rng.random(64) < 0.3).astype(np.float32)
+    for _ in range(3):
+        spmd.step(keys, labels)
+    table = np.asarray(spmd.state.value)
+    np.testing.assert_allclose(table[1 << 12 :], 0.0)  # trash + pad rows zero
