@@ -1,0 +1,222 @@
+"""Wire filters: symmetric per-link message codecs (DCN plane).
+
+Reference component #13 (``src/filter/*`` [U]): each RemoteNode link applies
+a filter chain on send and the inverse chain on receive — key-list caching
+(skip resending identical key arrays), compression (LZ4 there, zlib here —
+stdlib, no vendored deps), and float->int fixed-point (int8 quantization,
+``ops/quantize.py``).  ICI traffic never sees these; they exist for the DCN
+Van and are exercised in-process through the LoopbackVan for tests and byte
+accounting (the reference's network_usage.h role).
+
+Filters mutate copies of the Message and must satisfy
+``decode(encode(msg)) == msg`` (up to quantization error for FixingFloat).
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from parameter_server_tpu.core.messages import Message
+from parameter_server_tpu.ops.quantize import dequantize_int8, quantize_int8
+from parameter_server_tpu.utils.keys import mix64
+
+
+def _msg_copy(msg: Message) -> Message:
+    import dataclasses
+
+    # copy the Task too: filters rewrite payload, and the sender's Message
+    # object must stay untouched (Customer bookkeeping aliases it).
+    task = dataclasses.replace(msg.task, payload=dict(msg.task.payload))
+    return Message(
+        task=task,
+        sender=msg.sender,
+        recver=msg.recver,
+        keys=msg.keys,
+        values=list(msg.values),
+        is_request=msg.is_request,
+    )
+
+
+class Filter:
+    name = "base"
+
+    def encode(self, msg: Message) -> Message:
+        return msg
+
+    def decode(self, msg: Message) -> Message:
+        return msg
+
+
+class KeyCachingFilter(Filter):
+    """Drop the key array when the receiver has seen it (hash match).
+
+    The reference caches key lists per link with a checksum
+    (``src/filter/key_caching.h`` [U]); repeated pulls/pushes over the same
+    key set (block iterations) then ship only the hash.
+    """
+
+    name = "key_caching"
+
+    def __init__(self) -> None:
+        self._send_cache: Dict[tuple, Tuple[int, np.ndarray]] = {}
+        self._recv_cache: Dict[tuple, Tuple[int, np.ndarray]] = {}
+        self.hits = 0
+
+    @staticmethod
+    def _link(msg: Message) -> tuple:
+        return (msg.sender, msg.recver, msg.task.customer, msg.task.kind)
+
+    @staticmethod
+    def _hash(keys: np.ndarray) -> int:
+        h = mix64(np.asarray(keys, np.uint64))
+        return int(h.sum() ^ np.uint64(keys.size))
+
+    def encode(self, msg: Message) -> Message:
+        if msg.keys is None:
+            return msg
+        link = self._link(msg)
+        h = self._hash(msg.keys)
+        out = _msg_copy(msg)
+        out.task.payload = dict(msg.task.payload, key_hash=h)
+        cached = self._send_cache.get(link)
+        if cached is not None and cached[0] == h:
+            out.keys = None  # receiver restores from its cache
+            self.hits += 1
+        else:
+            self._send_cache[link] = (h, msg.keys)
+        return out
+
+    def decode(self, msg: Message) -> Message:
+        h = msg.task.payload.get("key_hash")
+        if h is None:
+            return msg
+        link = self._link(msg)
+        out = _msg_copy(msg)
+        if out.keys is None:
+            cached = self._recv_cache.get(link)
+            if cached is None or cached[0] != h:
+                raise RuntimeError(
+                    f"key-cache miss on {link}: receiver lost the key list"
+                )
+            out.keys = cached[1]
+        else:
+            self._recv_cache[link] = (h, out.keys)
+        out.task.payload = {
+            k: v for k, v in out.task.payload.items() if k != "key_hash"
+        }
+        return out
+
+
+class CompressingFilter(Filter):
+    """zlib-compress value arrays (the reference's LZ4 role)."""
+
+    name = "compressing"
+
+    def __init__(self, level: int = 1) -> None:
+        self.level = level
+        self.bytes_in = 0
+        self.bytes_out = 0
+
+    def encode(self, msg: Message) -> Message:
+        out = _msg_copy(msg)
+        blobs = []
+        meta = []
+        for v in msg.values:
+            v = np.ascontiguousarray(v)
+            raw = v.tobytes()
+            comp = zlib.compress(raw, self.level)
+            self.bytes_in += len(raw)
+            self.bytes_out += len(comp)
+            blobs.append(np.frombuffer(comp, np.uint8))
+            meta.append((v.dtype.str, v.shape))
+        out.values = blobs
+        out.task.payload = dict(msg.task.payload, zlib_meta=meta)
+        return out
+
+    def decode(self, msg: Message) -> Message:
+        meta = msg.task.payload.get("zlib_meta")
+        if meta is None:
+            return msg
+        out = _msg_copy(msg)
+        out.values = [
+            np.frombuffer(
+                zlib.decompress(np.asarray(b).tobytes()), np.dtype(dt)
+            ).reshape(shape)
+            for b, (dt, shape) in zip(msg.values, meta)
+        ]
+        out.task.payload = {
+            k: v for k, v in msg.task.payload.items() if k != "zlib_meta"
+        }
+        return out
+
+
+class FixingFloatFilter(Filter):
+    """float32 -> int8 + scale per value array (fixing_float analogue)."""
+
+    name = "fixing_float"
+
+    def __init__(self, stochastic: bool = False, seed: int = 0) -> None:
+        self.stochastic = stochastic
+        self._rng = np.random.default_rng(seed)
+
+    def encode(self, msg: Message) -> Message:
+        out = _msg_copy(msg)
+        vals = []
+        scales = []
+        quantized = []
+        for v in msg.values:
+            v = np.asarray(v)
+            if v.dtype == np.float32 and v.size:
+                q, s = quantize_int8(
+                    v, per_row=v.ndim >= 2, stochastic=self.stochastic,
+                    rng=self._rng,
+                )
+                vals.append(q)
+                scales.append(s)
+                quantized.append(True)
+            else:
+                vals.append(v)
+                scales.append(None)
+                quantized.append(False)
+        out.values = vals
+        out.task.payload = dict(
+            msg.task.payload, q8_scales=scales, q8_mask=quantized
+        )
+        return out
+
+    def decode(self, msg: Message) -> Message:
+        mask = msg.task.payload.get("q8_mask")
+        if mask is None:
+            return msg
+        scales = msg.task.payload["q8_scales"]
+        out = _msg_copy(msg)
+        out.values = [
+            dequantize_int8(v, s) if is_q else v
+            for v, s, is_q in zip(msg.values, scales, mask)
+        ]
+        out.task.payload = {
+            k: v
+            for k, v in msg.task.payload.items()
+            if k not in ("q8_scales", "q8_mask")
+        }
+        return out
+
+
+class FilterChain:
+    """Apply filters in order on send, reverse order on receive."""
+
+    def __init__(self, filters: List[Filter]) -> None:
+        self.filters = filters
+
+    def encode(self, msg: Message) -> Message:
+        for f in self.filters:
+            msg = f.encode(msg)
+        return msg
+
+    def decode(self, msg: Message) -> Message:
+        for f in reversed(self.filters):
+            msg = f.decode(msg)
+        return msg

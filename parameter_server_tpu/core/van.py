@@ -73,10 +73,17 @@ class LoopbackVan(Van):
     from A to B arrive in send order; cross-sender order is unspecified.
     """
 
-    def __init__(self) -> None:
+    def __init__(self, filter_chain=None) -> None:
+        """``filter_chain``: optional ``core.filters.FilterChain`` applied
+        encode-on-send / decode-on-receive (the reference's per-link filter
+        stack; loopback exercises the same codec path DCN traffic uses)."""
         self._endpoints: dict[str, _Endpoint] = {}
         self._disconnected: set[str] = set()
         self._lock = threading.Lock()
+        self.filter_chain = filter_chain
+        # filters hold mutable per-link state (caches, byte counters, RNG);
+        # serialized separately from the endpoint lock to keep send cheap
+        self._filter_lock = threading.Lock()
         #: counters for the dashboard (reference network_usage.h role).
         self.sent_messages = 0
         self.dropped_messages = 0
@@ -100,6 +107,9 @@ class LoopbackVan(Van):
             return False
         with self._lock:
             self.sent_messages += 1
+        if self.filter_chain is not None:
+            with self._filter_lock:
+                msg = self.filter_chain.decode(self.filter_chain.encode(msg))
         ep.inbox.put(msg)
         return True
 

@@ -58,3 +58,41 @@ class SyntheticCTR:
         p = 1.0 / (1.0 + np.exp(-logits))
         labels = (rng.random(self.batch_size) < p).astype(np.float32)
         return keys, labels
+
+
+@dataclasses.dataclass
+class SyntheticDLRM:
+    """Criteo-DLRM-shaped batches: dense floats + categorical keys + label.
+
+    The label depends on both the dense features and per-key hidden weights,
+    so learning requires the MLPs *and* the embedding table to train.
+    """
+
+    key_space: int = 1 << 20
+    n_dense: int = 13
+    n_sparse: int = 26
+    batch_size: int = 512
+    seed: int = 0
+
+    def __post_init__(self) -> None:
+        self._rng = np.random.default_rng(self.seed)
+        self._w_dense = np.random.default_rng(self.seed + 1).normal(
+            size=self.n_dense
+        ) / np.sqrt(self.n_dense)
+
+    def _key_effect(self, keys: np.ndarray) -> np.ndarray:
+        h = mix64(keys, seed=0x5EED)
+        sign = np.where((h >> np.uint64(2)) & np.uint64(1), 1.0, -1.0)
+        active = (h % np.uint64(4)) == 0  # quarter of keys matter
+        return np.where(active, sign * 0.5, 0.0)
+
+    def next_batch(self):
+        rng = self._rng
+        dense = rng.normal(size=(self.batch_size, self.n_dense)).astype(np.float32)
+        raw = rng.zipf(1.2, size=(self.batch_size, self.n_sparse)).astype(np.uint64)
+        keys = mix64(raw, seed=11) % np.uint64(self.key_space)
+        logits = dense @ self._w_dense + self._key_effect(keys).sum(axis=1) - 0.5
+        labels = (rng.random(self.batch_size) < 1 / (1 + np.exp(-logits))).astype(
+            np.float32
+        )
+        return keys, dense, labels

@@ -1,0 +1,160 @@
+"""Dense-tensor KV store: whole-model chunks across servers (KVLayer/KVStore).
+
+The reference chunks big dense tensors (NN layers) across servers so workers
+push gradients / pull weights for entire layers (``src/parameter/kv_store.h``,
+``kv_layer.h`` [U]).  TPU-native version: the model's parameter pytree is
+flattened to one contiguous float32 vector; servers own contiguous segments
+(the NodeAssigner range scheme on *element offsets* instead of keys) stored
+on device with row-wise optimizer state; workers push/pull the whole vector
+(or per-layer slices later) through the Van with the usual timestamp API.
+
+This is the path BASELINE config #4 uses (BERT async push/pull of dense
+layers) and the Van-mode counterpart of the pure-GSPMD DP trainer in
+``learner/dense.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.flatten_util import ravel_pytree
+
+from parameter_server_tpu.config import OptimizerConfig
+from parameter_server_tpu.core.messages import Message, Task, TaskKind, server_id
+from parameter_server_tpu.core.postoffice import Customer, Postoffice
+from parameter_server_tpu.kv.optim import ServerOptimizer, make_optimizer
+
+
+def segment_offsets(total: int, num_servers: int) -> np.ndarray:
+    """num_servers+1 element offsets; server s owns [off[s], off[s+1])."""
+    base, rem = divmod(total, num_servers)
+    sizes = [base + (1 if s < rem else 0) for s in range(num_servers)]
+    return np.cumsum([0] + sizes)
+
+
+class DenseKVServer(Customer):
+    """Owns one contiguous segment of each registered dense parameter vector."""
+
+    def __init__(
+        self,
+        post: Postoffice,
+        specs: Dict[str, Tuple[int, OptimizerConfig]],
+        server_index: int,
+        num_servers: int,
+        *,
+        name: str = "dense",
+        init_vectors: Optional[Dict[str, np.ndarray]] = None,
+    ) -> None:
+        """``specs``: table name -> (total_elements, optimizer config)."""
+        super().__init__(name, post)
+        self.server_index = server_index
+        self.segments: Dict[str, dict] = {}
+        for t, (total, opt_cfg) in specs.items():
+            off = segment_offsets(total, num_servers)
+            lo, hi = int(off[server_index]), int(off[server_index + 1])
+            opt = make_optimizer(opt_cfg)
+            if init_vectors and t in init_vectors:
+                value = jnp.asarray(init_vectors[t][lo:hi], jnp.float32)
+            else:
+                value = jnp.zeros(hi - lo, jnp.float32)
+            self.segments[t] = {
+                "opt": opt,
+                "value": value.reshape(-1, 1),
+                "state": {
+                    k: jnp.full((hi - lo, 1), fill, jnp.float32)
+                    for k, fill in opt.state_shapes().items()
+                },
+                "apply": jax.jit(
+                    lambda v, s, g, _opt=opt: _opt.apply(v, s, g),
+                    donate_argnums=(0, 1),
+                ),
+                "pull": jax.jit(lambda v, s, _opt=opt: _opt.pull_weights(v, s)),
+            }
+
+    def handle_request(self, msg: Message) -> Message:
+        seg = self.segments[msg.task.payload["table"]]
+        if msg.task.kind == TaskKind.PUSH:
+            grad = jnp.asarray(msg.values[0]).reshape(-1, 1)
+            seg["value"], seg["state"] = seg["apply"](
+                seg["value"], seg["state"], grad
+            )
+            return msg.reply()
+        elif msg.task.kind == TaskKind.PULL:
+            w = seg["pull"](seg["value"], seg["state"])
+            return msg.reply(values=[np.asarray(w).ravel()])
+        raise ValueError(f"unsupported task kind {msg.task.kind}")
+
+
+class DenseKVWorker(Customer):
+    """Push/pull whole flattened parameter vectors with timestamps."""
+
+    def __init__(
+        self,
+        post: Postoffice,
+        specs: Dict[str, int],
+        num_servers: int,
+        *,
+        name: str = "dense",
+    ) -> None:
+        """``specs``: table name -> total_elements."""
+        super().__init__(name, post)
+        self.offsets = {
+            t: segment_offsets(total, num_servers) for t, total in specs.items()
+        }
+        self.num_servers = num_servers
+        self._pull_meta: Dict[int, str] = {}
+
+    def push(self, table: str, grad_vector: np.ndarray) -> int:
+        off = self.offsets[table]
+        msgs = [
+            Message(
+                task=Task(TaskKind.PUSH, self.name, payload={"table": table}),
+                recver=server_id(s),
+                values=[np.asarray(grad_vector[off[s] : off[s + 1]], np.float32)],
+            )
+            for s in range(self.num_servers)
+        ]
+        return self.submit(msgs)
+
+    def pull(self, table: str) -> int:
+        msgs = [
+            Message(
+                task=Task(TaskKind.PULL, self.name, payload={"table": table}),
+                recver=server_id(s),
+            )
+            for s in range(self.num_servers)
+        ]
+        ts = self.submit(msgs)
+        self._pull_meta[ts] = table
+        return ts
+
+    def pull_result(self, ts: int, timeout: Optional[float] = None) -> np.ndarray:
+        if not self.wait(ts, timeout):
+            raise TimeoutError(f"dense pull ts={ts} timed out")
+        table = self._pull_meta.pop(ts)
+        off = self.offsets[table]
+        out = np.zeros(off[-1], np.float32)
+        for resp in self.responses(ts):
+            s = int(resp.sender[1:])
+            out[off[s] : off[s + 1]] = resp.values[0]
+        return out
+
+    def pull_sync(self, table: str, timeout: Optional[float] = None) -> np.ndarray:
+        return self.pull_result(self.pull(table), timeout)
+
+
+class PytreeCodec:
+    """Flatten/unflatten a parameter pytree to the store's flat vector."""
+
+    def __init__(self, example_tree) -> None:
+        flat, self.unravel = ravel_pytree(example_tree)
+        self.total = int(flat.shape[0])
+
+    def flatten(self, tree) -> np.ndarray:
+        return np.asarray(ravel_pytree(tree)[0], np.float32)
+
+    def unflatten(self, vector: np.ndarray):
+        return self.unravel(jnp.asarray(vector, jnp.float32))

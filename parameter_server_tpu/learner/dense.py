@@ -1,0 +1,209 @@
+"""Dense-model trainers: GSPMD data-parallel and Van-path async PS.
+
+Covers BASELINE configs #2 (ResNet-50 DP under BSP/SSP) and #4 (BERT-style
+async push/pull of dense layers):
+
+- :class:`SpmdDenseTrainer`: one jit-compiled train step over the mesh;
+  batch sharded on ``data``, params replicated (DP); the gradient mean over
+  the global batch IS the psum over ICI.  BSP by construction.
+- :class:`AsyncDenseLearner`: N worker threads each holding a local jit
+  train-grad function; per iteration they pull the flat parameter vector
+  from the :class:`~parameter_server_tpu.kv.dense.DenseKVServer`s, compute
+  gradients on their shard, push, and advance the consistency clock —
+  BSP/SSP/ASP selected exactly as in the sparse path.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from parameter_server_tpu.config import ConsistencyConfig
+from parameter_server_tpu.core.clock import ConsistencyController
+from parameter_server_tpu.kv.dense import DenseKVWorker, PytreeCodec
+from parameter_server_tpu.parallel import mesh as mesh_lib
+from parameter_server_tpu.utils import metrics as metrics_lib
+
+Batch = Tuple[np.ndarray, np.ndarray]
+BatchFn = Callable[[], Batch]
+
+
+def softmax_xent(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Mean cross-entropy with integer labels."""
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=1))
+
+
+def _split_variables(variables):
+    params = variables["params"]
+    extra = {k: v for k, v in variables.items() if k != "params"}
+    return params, extra
+
+
+class SpmdDenseTrainer:
+    """Pure-DP GSPMD trainer for a flax model (BSP)."""
+
+    def __init__(
+        self,
+        model,
+        tx: optax.GradientTransformation,
+        mesh,
+        example_batch: Batch,
+        *,
+        seed: int = 0,
+        loss_fn=softmax_xent,
+    ) -> None:
+        self.model = model
+        self.tx = tx
+        self.mesh = mesh
+        self.loss_fn = loss_fn
+        images, labels = example_batch
+        variables = model.init(
+            jax.random.PRNGKey(seed), jnp.asarray(images[:1]), train=False
+        )
+        params, extra = _split_variables(variables)
+        repl = mesh_lib.replicated(mesh)
+        self.params = jax.device_put(params, repl)
+        self.extra = jax.device_put(extra, repl)
+        self.opt_state = jax.device_put(tx.init(params), repl)
+        self._batch_img = mesh_lib.batch_sharding(mesh, np.asarray(images).ndim)
+        self._batch_lbl = mesh_lib.batch_sharding(mesh, 1)
+
+        def train_step(params, extra, opt_state, images, labels):
+            def loss(p):
+                out, new_extra = model.apply(
+                    {"params": p, **extra},
+                    images,
+                    train=True,
+                    mutable=list(extra.keys()) or False,
+                )
+                return self.loss_fn(out, labels), new_extra
+
+            (l, new_extra), grads = jax.value_and_grad(loss, has_aux=True)(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, new_extra, opt_state, l
+
+        self._step = jax.jit(
+            train_step,
+            in_shardings=(repl, repl, repl, self._batch_img, self._batch_lbl),
+            out_shardings=(repl, repl, repl, repl),
+            donate_argnums=(0, 1, 2),
+        )
+
+    def step(self, images: np.ndarray, labels: np.ndarray) -> float:
+        images = jax.device_put(jnp.asarray(images), self._batch_img)
+        labels = jax.device_put(jnp.asarray(labels), self._batch_lbl)
+        self.params, self.extra, self.opt_state, loss = self._step(
+            self.params, self.extra, self.opt_state, images, labels
+        )
+        return float(loss)
+
+    def eval_logits(self, images: np.ndarray) -> np.ndarray:
+        out = self.model.apply(
+            {"params": self.params, **self.extra},
+            jnp.asarray(images),
+            train=False,
+        )
+        return np.asarray(out)
+
+
+class AsyncDenseLearner:
+    """Async PS training of a dense (flax) model over the Van.
+
+    Workers keep local BatchNorm-style collections (standard async-PS
+    behavior); only ``params`` travel through the store.
+    """
+
+    def __init__(
+        self,
+        model,
+        workers: list[DenseKVWorker],
+        consistency: ConsistencyConfig,
+        example_batch: Batch,
+        *,
+        table: str = "model",
+        seed: int = 0,
+        loss_fn=softmax_xent,
+        dashboard: Optional[metrics_lib.Dashboard] = None,
+    ) -> None:
+        self.model = model
+        self.kv_workers = workers
+        self.table = table
+        self.controller = ConsistencyController(consistency, len(workers))
+        self.dashboard = dashboard or metrics_lib.Dashboard(print_every=0)
+        images, labels = example_batch
+        variables = model.init(
+            jax.random.PRNGKey(seed), jnp.asarray(images[:1]), train=False
+        )
+        params, extra = _split_variables(variables)
+        self.codec = PytreeCodec(params)
+        self.init_params = params
+        self._extra0 = extra
+        self.loss_fn = loss_fn
+        self._lock = threading.Lock()
+        self._losses: list[float] = []
+
+        def grad_step(params, extra, images, labels):
+            def loss(p):
+                out, new_extra = model.apply(
+                    {"params": p, **extra},
+                    images,
+                    train=True,
+                    mutable=list(extra.keys()) or False,
+                )
+                return self.loss_fn(out, labels), new_extra
+
+            (l, new_extra), grads = jax.value_and_grad(loss, has_aux=True)(params)
+            return grads, new_extra, l
+
+        self._grad_step = jax.jit(grad_step)
+
+    def initial_vector(self) -> np.ndarray:
+        """Flat init vector to seed the servers (pass as init_vectors)."""
+        return self.codec.flatten(self.init_params)
+
+    def run(
+        self,
+        batch_fns: list[BatchFn],
+        steps_per_worker: int,
+        *,
+        timeout: float = 120.0,
+    ) -> list[float]:
+        threads = [
+            threading.Thread(
+                target=self._worker_loop,
+                args=(kv, batch_fns[i], i, steps_per_worker, timeout),
+                name=f"dense-worker-{i}",
+            )
+            for i, kv in enumerate(self.kv_workers)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return list(self._losses)
+
+    def _worker_loop(self, kv, batch_fn, index, steps, timeout):
+        extra = self._extra0
+        for t in range(steps):
+            if not self.controller.wait_turn(index, t, timeout=timeout):
+                raise TimeoutError(f"worker {index} stalled at iter {t}")
+            images, labels = batch_fn()
+            params = self.codec.unflatten(kv.pull_sync(self.table, timeout))
+            grads, extra, loss = self._grad_step(
+                params, extra, jnp.asarray(images), jnp.asarray(labels)
+            )
+            ts = kv.push(self.table, self.codec.flatten(grads))
+            kv.wait(ts, timeout)
+            self.controller.finish_iteration(index)
+            with self._lock:
+                self._losses.append(float(loss))
+                self.dashboard.record(
+                    len(self._losses), float(loss), examples=labels.shape[0]
+                )

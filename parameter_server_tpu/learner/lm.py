@@ -1,0 +1,113 @@
+"""Language-model trainers: BERT MLM and causal LM (Llama) over the mesh.
+
+BASELINE configs #4/#5.  DP x TP: batch sharded over ``data``, params sharded
+per ``parallel/tp.py`` (embedding rows over ``model`` = the PS-shard; XLA
+emits the tensor-parallel collectives).  Optimizer state inherits the param
+shardings (eager ``zeros_like`` preserves sharding), so the whole train state
+is mesh-partitioned without further annotation.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from parameter_server_tpu.models import transformer as tfm
+from parameter_server_tpu.parallel import mesh as mesh_lib
+from parameter_server_tpu.parallel.tp import place_params
+
+
+def make_mlm_batch(
+    tokens: np.ndarray, vocab_size: int, rng: np.random.Generator,
+    mask_token: int = 0, mask_rate: float = 0.15,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """BERT masking: 15% positions; 80% [MASK], 10% random, 10% kept."""
+    mask = rng.random(tokens.shape) < mask_rate
+    r = rng.random(tokens.shape)
+    inputs = tokens.copy()
+    inputs[mask & (r < 0.8)] = mask_token
+    rand_sites = mask & (r >= 0.8) & (r < 0.9)
+    inputs[rand_sites] = rng.integers(
+        0, vocab_size, size=int(rand_sites.sum()), dtype=tokens.dtype
+    )
+    return inputs, tokens, mask.astype(np.float32)
+
+
+class SpmdLMTrainer:
+    """DP x TP trainer for the transformer family."""
+
+    def __init__(
+        self,
+        cfg: tfm.TransformerConfig,
+        mesh,
+        *,
+        learning_rate: float = 1e-3,
+        seed: int = 0,
+    ) -> None:
+        self.cfg = cfg
+        self.mesh = mesh
+        self.model = tfm.Transformer(cfg)
+        self.tx = optax.adamw(learning_rate)
+        tokens0 = jnp.zeros((1, 8), jnp.int32)
+        params = self.model.init(jax.random.PRNGKey(seed), tokens0)["params"]
+        self.params = place_params(params, mesh)
+        # optimizer state inherits param shardings through eager zeros_like
+        self.opt_state = self.tx.init(self.params)
+        self._batch2 = mesh_lib.batch_sharding(mesh, 2)
+        model, tx = self.model, self.tx
+
+        if cfg.causal:
+
+            def loss_fn(params, inputs, targets, mask):
+                logits = model.apply({"params": params}, inputs)
+                return tfm.causal_lm_loss(logits, targets)
+
+        else:
+
+            def loss_fn(params, inputs, targets, mask):
+                logits = model.apply({"params": params}, inputs)
+                return tfm.mlm_loss(logits, targets, mask)
+
+        def step_fn(params, opt_state, inputs, targets, mask):
+            loss, grads = jax.value_and_grad(loss_fn)(params, inputs, targets, mask)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        self._step = jax.jit(step_fn, donate_argnums=(0, 1))
+
+    # -- steps --------------------------------------------------------------
+    def step_causal(self, tokens: np.ndarray) -> float:
+        if not self.cfg.causal:
+            raise ValueError("step_causal on a non-causal (MLM) trainer")
+        tokens_d = jax.device_put(jnp.asarray(tokens, jnp.int32), self._batch2)
+        self.params, self.opt_state, loss = self._step(
+            self.params, self.opt_state, tokens_d, tokens_d, tokens_d
+        )
+        return float(loss)
+
+    def step_mlm(
+        self, inputs: np.ndarray, targets: np.ndarray, mask: np.ndarray
+    ) -> float:
+        if self.cfg.causal:
+            raise ValueError("step_mlm on a causal-LM trainer")
+        put = lambda x, dt: jax.device_put(  # noqa: E731
+            jnp.asarray(x, dt), self._batch2
+        )
+        self.params, self.opt_state, loss = self._step(
+            self.params,
+            self.opt_state,
+            put(inputs, jnp.int32),
+            put(targets, jnp.int32),
+            put(mask, jnp.float32),
+        )
+        return float(loss)
+
+    def logits(self, tokens: np.ndarray) -> np.ndarray:
+        return np.asarray(
+            self.model.apply({"params": self.params}, jnp.asarray(tokens, jnp.int32))
+        )

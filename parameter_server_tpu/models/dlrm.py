@@ -1,0 +1,213 @@
+"""DLRM / Wide&Deep — BASELINE config #3 (billion-row sparse embeddings).
+
+Architecture (standard DLRM): dense features -> bottom MLP; categorical
+features -> embedding rows from the PS table; pairwise dot-product feature
+interactions; top MLP -> CTR logit.
+
+The embedding table is the parameter-server table: row-sharded over the
+``model`` mesh axis (the reference's key-range server partition — and the EP
+analogue called out in SURVEY.md §2: embedding shards ARE the expert shards).
+The train step differentiates w.r.t. the *gathered unique rows* — XLA's AD
+turns the ``rows[inverse]`` indexing into the duplicate-combining segment-sum
+(the reference's ParallelOrderedMatch merge) — and the row-wise ServerOptimizer
+applies the sparse update, so per-step memory is O(batch), never O(table).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh
+
+from parameter_server_tpu.config import TableConfig
+from parameter_server_tpu.kv.optim import ServerOptimizer, make_optimizer
+from parameter_server_tpu.models.linear import logloss
+from parameter_server_tpu.ops import scatter
+from parameter_server_tpu.parallel import mesh as mesh_lib
+from parameter_server_tpu.utils.keys import HashLocalizer, localize_to_slots
+
+
+class MLP(nn.Module):
+    features: Sequence[int]
+    final_activation: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        for i, f in enumerate(self.features):
+            x = nn.Dense(f)(x)
+            if i < len(self.features) - 1 or self.final_activation:
+                x = nn.relu(x)
+        return x
+
+
+class DLRM(nn.Module):
+    """Dense part of DLRM: bottom MLP, interactions, top MLP.
+
+    The embedding rows come in as an argument (they live in the PS table).
+    """
+
+    bottom_mlp: Sequence[int]
+    top_mlp: Sequence[int]
+    emb_dim: int
+
+    @nn.compact
+    def __call__(self, dense_feats: jax.Array, emb: jax.Array) -> jax.Array:
+        """dense_feats [B, n_dense]; emb [B, n_sparse, emb_dim] -> logits [B]."""
+        bottom = MLP(tuple(self.bottom_mlp) + (self.emb_dim,))(dense_feats)
+        feats = jnp.concatenate([bottom[:, None, :], emb], axis=1)  # [B, F, D]
+        inter = jnp.einsum(
+            "bfd,bgd->bfg", feats, feats, preferred_element_type=jnp.float32
+        )
+        f = feats.shape[1]
+        iu, ju = jnp.triu_indices(f, k=1)
+        inter_flat = inter[:, iu, ju]  # [B, F*(F-1)/2]
+        top_in = jnp.concatenate([bottom, inter_flat], axis=1)
+        logits = MLP(tuple(self.top_mlp) + (1,), final_activation=False)(top_in)
+        return logits[:, 0]
+
+
+class SpmdDLRMTrainer:
+    """DLRM over a (data, model) mesh: PS-sharded embeddings + DP dense part."""
+
+    def __init__(
+        self,
+        table_cfg: TableConfig,
+        mesh: Mesh,
+        *,
+        n_dense: int = 13,
+        n_sparse: int = 26,
+        bottom_mlp: Sequence[int] = (64, 32),
+        top_mlp: Sequence[int] = (64, 32),
+        learning_rate: float = 0.01,
+        min_bucket: int = 1024,
+        seed: int = 0,
+    ) -> None:
+        self.cfg = table_cfg
+        self.mesh = mesh
+        self.n_sparse = n_sparse
+        self.min_bucket = min_bucket
+        self.optimizer: ServerOptimizer = make_optimizer(table_cfg.optimizer)
+        self.localizer = HashLocalizer(table_cfg.rows, seed=seed)
+        self.model = DLRM(
+            bottom_mlp=bottom_mlp, top_mlp=top_mlp, emb_dim=table_cfg.dim
+        )
+        self.tx = optax.adam(learning_rate)
+
+        t_shard = mesh_lib.table_sharding(mesh)
+        repl = mesh_lib.replicated(mesh)
+        n_model = mesh.shape[mesh_lib.MODEL_AXIS]
+        self.total_rows = ((table_cfg.rows + 1 + n_model - 1) // n_model) * n_model
+
+        key = jax.random.PRNGKey(seed)
+        k_table, k_mlp = jax.random.split(key)
+        value = (
+            jax.random.normal(k_table, (self.total_rows, table_cfg.dim))
+            * table_cfg.init_scale
+        ).astype(jnp.float32)
+        value = value.at[table_cfg.rows :].set(0.0)  # trash + pad rows
+        self.emb_value = jax.device_put(value, t_shard)
+        self.emb_state = {
+            k: jax.device_put(
+                jnp.full((self.total_rows, table_cfg.dim), fill, jnp.float32),
+                t_shard,
+            )
+            for k, fill in self.optimizer.state_shapes().items()
+        }
+        dense0 = jnp.zeros((1, n_dense), jnp.float32)
+        emb0 = jnp.zeros((1, n_sparse, table_cfg.dim), jnp.float32)
+        self.mlp_params = jax.device_put(
+            self.model.init(k_mlp, dense0, emb0)["params"], repl
+        )
+        self.opt_state = jax.device_put(self.tx.init(self.mlp_params), repl)
+
+        batch2 = mesh_lib.batch_sharding(mesh, 2)
+        batch1 = mesh_lib.batch_sharding(mesh, 1)
+        model, optimizer, tx = self.model, self.optimizer, self.tx
+        n_sparse_ = n_sparse
+        self_trash = table_cfg.rows  # trash row id (pads live past it)
+
+        def step_fn(
+            emb_value, emb_state, mlp_params, opt_state,
+            ids, inverse, dense_feats, labels,
+        ):
+            batch = labels.shape[0]
+            v_rows = scatter.gather_rows(emb_value, ids)
+            s_rows = {k: scatter.gather_rows(v, ids) for k, v in emb_state.items()}
+            w_rows = optimizer.pull_weights(v_rows, s_rows)
+
+            def loss_fn(mlp_p, rows):
+                emb = rows[inverse].reshape(batch, n_sparse_, -1)
+                logits = model.apply({"params": mlp_p}, dense_feats, emb)
+                return logloss(logits, labels)
+
+            l, (g_mlp, g_rows) = jax.value_and_grad(loss_fn, argnums=(0, 1))(
+                mlp_params, w_rows
+            )
+            updates, opt_state = tx.update(g_mlp, opt_state, mlp_params)
+            mlp_params = optax.apply_updates(mlp_params, updates)
+            new_v, new_s = optimizer.apply(v_rows, s_rows, g_rows)
+            emb_value = scatter.scatter_update_rows_xla(emb_value, ids, new_v)
+            emb_state = {
+                k: scatter.scatter_update_rows_xla(emb_state[k], ids, new_s[k])
+                for k in emb_state
+            }
+            # trash-row reset (PAD gradients)
+            fills = optimizer.state_shapes()
+            emb_value = emb_value.at[self_trash].set(0.0)
+            emb_state = {k: emb_state[k].at[self_trash].set(fills[k]) for k in emb_state}
+            return emb_value, emb_state, mlp_params, opt_state, l
+
+        self._step = jax.jit(
+            step_fn,
+            in_shardings=(
+                t_shard,
+                {k: t_shard for k in self.emb_state},
+                repl,
+                repl,
+                repl,  # ids: replicated unique slots
+                repl,  # inverse
+                batch2,
+                batch1,
+            ),
+            out_shardings=(
+                t_shard,
+                {k: t_shard for k in self.emb_state},
+                repl,
+                repl,
+                repl,
+            ),
+            donate_argnums=(0, 1, 2, 3),
+        )
+
+    def step(
+        self,
+        keys: np.ndarray,
+        dense_feats: np.ndarray,
+        labels: np.ndarray,
+    ) -> float:
+        slots, inverse, _n = localize_to_slots(
+            keys, self.localizer, min_bucket=self.min_bucket
+        )
+        (
+            self.emb_value,
+            self.emb_state,
+            self.mlp_params,
+            self.opt_state,
+            loss,
+        ) = self._step(
+            self.emb_value,
+            self.emb_state,
+            self.mlp_params,
+            self.opt_state,
+            jnp.asarray(slots),
+            jnp.asarray(inverse),
+            jnp.asarray(dense_feats),
+            jnp.asarray(labels),
+        )
+        return float(loss)

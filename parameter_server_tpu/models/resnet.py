@@ -1,0 +1,118 @@
+"""ResNet (v1.5 bottleneck) in flax — BASELINE config #2 (ResNet-50/ImageNet).
+
+The reference has no CNN zoo (it predates them); the north star adds
+"ResNet-50 async SGD" as a target workload, so the model is built TPU-first:
+NHWC layout (TPU conv-native), flax BatchNorm whose batch statistics are
+computed over the *global* (data-sharded) batch under jit/GSPMD — the
+cross-replica sync that would be a NCCL allreduce elsewhere is just the
+reduction XLA inserts.
+
+ResNet-50 == ``ResNet(stage_sizes=[3, 4, 6, 3], bottleneck=True)``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: int
+    conv: ModuleDef
+    norm: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3), strides=(self.strides, self.strides))(y)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters * 4, (1, 1), strides=(self.strides, self.strides),
+                name="shortcut",
+            )(residual)
+            residual = self.norm(name="shortcut_bn")(residual)
+        return nn.relu(residual + y)
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    strides: int
+    conv: ModuleDef
+    norm: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), strides=(self.strides, self.strides))(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters, (1, 1), strides=(self.strides, self.strides),
+                name="shortcut",
+            )(residual)
+            residual = self.norm(name="shortcut_bn")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    num_classes: int = 1000
+    width: int = 64
+    bottleneck: bool = True
+    dtype: Any = jnp.float32
+    #: small-image mode (CIFAR-style): 3x3 stem, no max-pool
+    small_inputs: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = functools.partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=self.dtype,
+        )
+        block = BottleneckBlock if self.bottleneck else BasicBlock
+
+        if self.small_inputs:
+            x = conv(self.width, (3, 3), name="stem")(x)
+        else:
+            x = conv(self.width, (7, 7), strides=(2, 2), name="stem")(x)
+        x = norm(name="stem_bn")(x)
+        x = nn.relu(x)
+        if not self.small_inputs:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = block(
+                    self.width * 2**i, strides, conv=conv, norm=norm
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x
+
+
+def resnet18(**kw) -> ResNet:
+    return ResNet(stage_sizes=[2, 2, 2, 2], bottleneck=False, **kw)
+
+
+def resnet50(**kw) -> ResNet:
+    return ResNet(stage_sizes=[3, 4, 6, 3], bottleneck=True, **kw)

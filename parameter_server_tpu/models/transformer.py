@@ -1,0 +1,236 @@
+"""Transformer family: one configurable module covering BERT and Llama.
+
+BASELINE configs #4 (BERT-base MLM) and #5 (Llama-3-8B hybrid).  The
+reference predates transformers; the north star adds them, with the Llama
+hybrid defined as "PS-sharded embeddings + XLA allreduce for transformer
+blocks": here the embedding table is row-sharded over the ``model`` mesh axis
+(exactly the KV table partition scheme) while attention/MLP weights use
+tensor-parallel sharding rules (``parallel/tp.py``) whose collectives XLA
+emits over ICI.
+
+Implementation notes (TPU-first):
+- all projections keep explicit head axes so GSPMD can shard heads;
+- rotary embeddings computed in f32 regardless of activation dtype;
+- GQA: n_kv_heads <= n_heads with head-group repetition;
+- no data-dependent control flow; causal masking via static tril.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int
+    n_layers: int
+    n_heads: int
+    d_model: int
+    d_ff: int
+    n_kv_heads: Optional[int] = None  # None -> == n_heads (MHA)
+    max_seq: int = 2048
+    causal: bool = True
+    positional: str = "rotary"  # "rotary" | "learned"
+    norm: str = "rms"  # "rms" | "ln"
+    activation: str = "swiglu"  # "swiglu" | "gelu"
+    tie_embeddings: bool = False
+    dtype: Any = jnp.float32
+    rope_theta: float = 500_000.0
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
+
+
+def bert_base(vocab_size: int = 30522, **kw) -> "TransformerConfig":
+    """BERT-base: 12L, 12H, 768d, bidirectional, learned pos, LN, GELU."""
+    return TransformerConfig(
+        vocab_size=vocab_size, n_layers=12, n_heads=12, d_model=768,
+        d_ff=3072, max_seq=512, causal=False, positional="learned",
+        norm="ln", activation="gelu", tie_embeddings=True, **kw,
+    )
+
+
+def llama3_8b(vocab_size: int = 128_256, **kw) -> "TransformerConfig":
+    """Llama-3-8B: 32L, 32H/8KV, 4096d, 14336ff, rotary, RMS, SwiGLU."""
+    return TransformerConfig(
+        vocab_size=vocab_size, n_layers=32, n_heads=32, n_kv_heads=8,
+        d_model=4096, d_ff=14336, max_seq=8192, **kw,
+    )
+
+
+def tiny_config(causal: bool = True, **kw) -> TransformerConfig:
+    """Small config for tests: same code paths, toy sizes."""
+    defaults = dict(
+        vocab_size=256, n_layers=2, n_heads=4, n_kv_heads=2, d_model=64,
+        d_ff=128, max_seq=64, causal=causal,
+    )
+    if not causal:
+        defaults.update(positional="learned", norm="ln", activation="gelu",
+                        n_kv_heads=4, tie_embeddings=True)
+    defaults.update(kw)
+    return TransformerConfig(**defaults)
+
+
+def _rotary(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Apply rotary embedding over the last (head_dim) axis. x: [B,S,H,D]."""
+    d = x.shape[-1]
+    freq = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = positions[:, :, None].astype(jnp.float32) * freq  # [B,S,D/2]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    out1 = x1 * cos - x2 * sin
+    out2 = x2 * cos + x1 * sin
+    out = jnp.stack([out1, out2], axis=-1).reshape(x.shape)
+    return out.astype(x.dtype)
+
+
+class Norm(nn.Module):
+    kind: str
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        if self.kind == "rms":
+            scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
+            var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+            return (x * jax.lax.rsqrt(var + 1e-6)).astype(self.dtype) * scale
+        return nn.LayerNorm(dtype=self.dtype)(x)
+
+
+class Attention(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions, attn_mask=None):
+        cfg = self.cfg
+        B, S, _ = x.shape
+        H, KV, D = cfg.n_heads, cfg.kv_heads, cfg.head_dim
+        dense = lambda heads, name: nn.DenseGeneral(  # noqa: E731
+            (heads, D), axis=-1, use_bias=cfg.norm == "ln", name=name,
+            dtype=cfg.dtype,
+        )
+        q = dense(H, "q")(x)  # [B,S,H,D]
+        k = dense(KV, "k")(x)
+        v = dense(KV, "v")(x)
+        if cfg.positional == "rotary":
+            q = _rotary(q, positions, cfg.rope_theta)
+            k = _rotary(k, positions, cfg.rope_theta)
+        if KV != H:
+            rep = H // KV
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        scores = jnp.einsum(
+            "bshd,bthd->bhst", q, k, preferred_element_type=jnp.float32
+        ) / np.sqrt(D)
+        if cfg.causal:
+            causal = jnp.tril(jnp.ones((S, S), bool))
+            scores = jnp.where(causal[None, None], scores, -1e30)
+        if attn_mask is not None:  # [B, S] True = attend
+            scores = jnp.where(attn_mask[:, None, None, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+        out = jnp.einsum(
+            "bhst,bthd->bshd", probs, v, preferred_element_type=jnp.float32
+        ).astype(cfg.dtype)
+        return nn.DenseGeneral(
+            cfg.d_model, axis=(-2, -1), use_bias=cfg.norm == "ln", name="o",
+            dtype=cfg.dtype,
+        )(out)
+
+
+class MLPBlock(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        bias = cfg.norm == "ln"
+        if cfg.activation == "swiglu":
+            gate = nn.Dense(cfg.d_ff, use_bias=bias, name="gate", dtype=cfg.dtype)(x)
+            up = nn.Dense(cfg.d_ff, use_bias=bias, name="up", dtype=cfg.dtype)(x)
+            h = nn.silu(gate) * up
+        else:
+            h = nn.gelu(
+                nn.Dense(cfg.d_ff, use_bias=bias, name="up", dtype=cfg.dtype)(x)
+            )
+        return nn.Dense(cfg.d_model, use_bias=bias, name="down", dtype=cfg.dtype)(h)
+
+
+class Block(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions, attn_mask=None):
+        cfg = self.cfg
+        h = Norm(cfg.norm, cfg.dtype, name="attn_norm")(x)
+        x = x + Attention(cfg, name="attn")(h, positions, attn_mask)
+        h = Norm(cfg.norm, cfg.dtype, name="mlp_norm")(x)
+        return x + MLPBlock(cfg, name="mlp")(h)
+
+
+class Transformer(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens, attn_mask=None):
+        """tokens [B, S] int32 -> logits [B, S, vocab]."""
+        cfg = self.cfg
+        emb = self.param(
+            "embedding",
+            nn.initializers.normal(0.02),
+            (cfg.vocab_size, cfg.d_model),
+        )
+        x = emb[tokens].astype(cfg.dtype)
+        B, S = tokens.shape
+        positions = jnp.broadcast_to(jnp.arange(S)[None], (B, S))
+        if cfg.positional == "learned":
+            pos_emb = self.param(
+                "pos_embedding",
+                nn.initializers.normal(0.02),
+                (cfg.max_seq, cfg.d_model),
+            )
+            x = x + pos_emb[None, :S].astype(cfg.dtype)
+        for i in range(cfg.n_layers):
+            x = Block(cfg, name=f"layer_{i}")(x, positions, attn_mask)
+        x = Norm(cfg.norm, cfg.dtype, name="final_norm")(x)
+        if cfg.tie_embeddings:
+            logits = jnp.einsum(
+                "bsd,vd->bsv", x, emb.astype(cfg.dtype),
+                preferred_element_type=jnp.float32,
+            )
+        else:
+            logits = nn.Dense(
+                cfg.vocab_size, use_bias=False, name="lm_head",
+                dtype=cfg.dtype,
+            )(x)
+        return logits.astype(jnp.float32)
+
+
+# -- losses -----------------------------------------------------------------
+
+
+def causal_lm_loss(logits: jax.Array, tokens: jax.Array) -> jax.Array:
+    """Next-token CE: predict tokens[:, 1:] from logits[:, :-1]."""
+    logp = jax.nn.log_softmax(logits[:, :-1])
+    tgt = tokens[:, 1:]
+    nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)
+
+
+def mlm_loss(logits: jax.Array, targets: jax.Array, mask: jax.Array) -> jax.Array:
+    """Masked-LM CE over masked positions only (mask True = predict)."""
+    logp = jax.nn.log_softmax(logits)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    denom = jnp.maximum(jnp.sum(mask), 1.0)
+    return jnp.sum(nll * mask) / denom

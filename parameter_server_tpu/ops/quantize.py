@@ -1,0 +1,43 @@
+"""Quantization codecs for the DCN plane.
+
+ICI traffic needs none of this (XLA collectives ride full-bandwidth links);
+cross-host Push/Pull over DCN benefits from int8 payloads — the analogue of
+the reference's fixing_float filter (``src/filter/fixing_float.h`` [U]) and
+of quantized-allreduce schemes (EQuARX, PAPERS.md [V]).
+
+Symmetric per-tensor (or per-row) int8 with float32 scale; stochastic
+rounding optionally matches the reference's random-round behavior.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def quantize_int8(
+    x: np.ndarray,
+    *,
+    per_row: bool = False,
+    stochastic: bool = False,
+    rng: Optional[np.random.Generator] = None,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """float array -> (int8 array, float32 scale).  scale shape: [] or [rows,1]."""
+    x = np.asarray(x, np.float32)
+    if per_row and x.ndim >= 2:
+        amax = np.max(np.abs(x), axis=tuple(range(1, x.ndim)), keepdims=True)
+    else:
+        amax = np.max(np.abs(x)) if x.size else np.float32(0.0)
+    scale = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
+    y = x / scale
+    if stochastic:
+        rng = rng or np.random.default_rng()
+        y = np.floor(y + rng.random(y.shape, dtype=np.float32))
+    else:
+        y = np.rint(y)
+    return np.clip(y, -127, 127).astype(np.int8), scale
+
+
+def dequantize_int8(q: np.ndarray, scale: np.ndarray) -> np.ndarray:
+    return q.astype(np.float32) * np.asarray(scale, np.float32)

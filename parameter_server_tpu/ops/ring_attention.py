@@ -1,0 +1,140 @@
+"""Ring attention: sequence-parallel exact attention via ppermute over ICI.
+
+Long-context support (SURVEY.md §5): the sequence is sharded over a mesh axis
+(``sp``); each device holds a local Q/K/V block.  K/V blocks rotate around
+the ring (``lax.ppermute``) while each device accumulates its Q block's
+attention with the numerically-stable online-softmax update (the flash/
+blockwise recurrence, all in f32):
+
+    m' = max(m, rowmax(S))          # running max
+    l' = l * exp(m - m') + rowsum(exp(S - m'))
+    o' = o * exp(m - m') + exp(S - m') V
+
+After ``n`` rotations every Q block has seen every K/V block; outputs are
+exact (not approximate) attention.  Communication is nearest-neighbor
+ppermute riding the ICI ring — the TPU-native replacement for the
+all-to-all/NCCL schemes GPU sequence parallelism uses.
+
+Causality across blocks uses global position offsets derived from the ring
+step: the K/V block at rotation ``r`` on device ``i`` originated on device
+``(i - r) mod n``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _block_attn(q, k, v, q_off, k_off, scale, causal):
+    """Partial (unnormalized) attention of one Q block vs one K/V block.
+
+    q: [B, Sq, H, D]; k/v: [B, Sk, H, D].  Returns (scores_max [B,H,Sq],
+    exp-sum [B,H,Sq], weighted values [B,Sq,H,D]) in f32.
+    """
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        sq, sk = q.shape[1], k.shape[1]
+        q_ids = q_off + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        k_ids = k_off + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s = jnp.where((k_ids <= q_ids)[None, None], s, -jnp.inf)
+    m = jnp.max(s, axis=-1)  # [B,H,Sq]
+    # guard fully-masked rows (no valid keys yet in this block)
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(s - m_safe[..., None])
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    l = jnp.sum(p, axis=-1)  # noqa: E741
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, v, preferred_element_type=jnp.float32)
+    return m_safe, l, o
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    causal: bool = False,
+) -> jax.Array:
+    """Exact attention over a sequence sharded on ``axis_name``.
+
+    Call inside shard_map.  q/k/v: [B, S_local, H, D] (same H on every
+    device — combine with Ulysses/TP for head sharding).  Returns
+    [B, S_local, H, D] in q.dtype.
+    """
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    s_local = q.shape[1]
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    q32, k32, v32 = (x.astype(jnp.float32) for x in (q, k, v))
+    q_off = idx * s_local
+
+    m0 = jnp.full(q.shape[:1] + (q.shape[2], s_local), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros_like(m0)
+    # constants must be marked device-varying to carry through the ring loop
+    m0 = jax.lax.pcast(m0, (axis_name,), to="varying")
+    l0 = jax.lax.pcast(l0, (axis_name,), to="varying")
+    o0 = jnp.zeros_like(q32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(r, carry):
+        m, l, o, kr, vr = carry  # noqa: E741
+        src = (idx - r) % n  # ring step r holds the block from device src
+        k_off = src * s_local
+        bm, bl, bo = _block_attn(q32, kr, vr, q_off, k_off, scale, causal)
+        new_m = jnp.maximum(m, bm)
+        # rescale both accumulators to the new max
+        alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - new_m), 0.0)
+        beta = jnp.where(jnp.isfinite(bm) & (bl > 0), jnp.exp(bm - new_m), 0.0)
+        new_l = l * alpha + bl * beta
+        new_o = (
+            o * alpha.transpose(0, 2, 1)[..., None]
+            + bo * beta.transpose(0, 2, 1)[..., None]
+        )
+        kr = jax.lax.ppermute(kr, axis_name, perm)
+        vr = jax.lax.ppermute(vr, axis_name, perm)
+        return new_m, new_l, new_o, kr, vr
+
+    m, l, o, _, _ = jax.lax.fori_loop(0, n, body, (m0, l0, o0, k32, v32))  # noqa: E741
+    denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return (o / denom).astype(q.dtype)
+
+
+def make_ring_attention(
+    mesh: Mesh, *, sp_axis: str, causal: bool = False
+) -> "jax.stages.Wrapped":
+    """jit-able wrapper: full [B, S, H, D] arrays sharded on S over sp_axis."""
+    from jax.experimental.shard_map import shard_map
+
+    spec = P(None, sp_axis, None, None)
+
+    fn = shard_map(
+        functools.partial(ring_attention, axis_name=sp_axis, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return jax.jit(fn)
+
+
+def reference_attention(q, k, v, *, causal=False) -> jax.Array:
+    """Plain full-softmax attention (test oracle)."""
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        sq, sk = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool))
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum(
+        "bhqk,bkhd->bqhd", p, v, preferred_element_type=jnp.float32
+    ).astype(q.dtype)

@@ -1,0 +1,68 @@
+"""Ulysses-style sequence parallelism: all-to-all head redistribution.
+
+The alternative to ring attention (SURVEY.md §5): instead of rotating K/V
+around the ring, redistribute — each device starts with the full head set on
+a sequence shard, all-to-alls to hold *all* sequence positions for a subset
+of heads, runs ordinary (full-sequence) attention locally, and all-to-alls
+back.  Two collectives per attention call; preferable when heads >> devices
+and the per-device full-sequence block fits memory.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from parameter_server_tpu.ops.ring_attention import reference_attention
+
+
+def ulysses_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, *, axis_name: str,
+    causal: bool = False,
+) -> jax.Array:
+    """Call inside shard_map. q/k/v: [B, S_local, H, D]; H % axis_size == 0.
+
+    Returns [B, S_local, H, D].
+    """
+    n = jax.lax.axis_size(axis_name)
+
+    def seq_to_heads(x):  # [B, S_loc, H, D] -> [B, S_glob, H/n, D]
+        b, s_loc, h, d = x.shape
+        x = x.reshape(b, s_loc, n, h // n, d)
+        # all_to_all: split axis 2 (head groups) across devices, concat axis 1
+        x = jax.lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=False
+        )
+        return x.reshape(b, n * s_loc, h // n, d)
+
+    def heads_to_seq(x):  # [B, S_glob, H/n, D] -> [B, S_loc, H, D]
+        b, s_glob, hn, d = x.shape
+        x = x.reshape(b, n, s_glob // n, hn, d)
+        x = jax.lax.all_to_all(
+            x, axis_name, split_axis=1, concat_axis=3, tiled=False
+        )
+        # received shape [B, S_loc, hn, n, D]: the materialized source-device
+        # axis (== head GROUP) lands after the within-group axis; global head
+        # order is group-major, so swap before flattening.
+        x = x.transpose(0, 1, 3, 2, 4)
+        return x.reshape(b, s_glob // n, n * hn, d)
+
+    qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    out = reference_attention(qg, kg, vg, causal=causal)
+    return heads_to_seq(out)
+
+
+def make_ulysses_attention(mesh: Mesh, *, sp_axis: str, causal: bool = False):
+    from jax.experimental.shard_map import shard_map
+
+    spec = P(None, sp_axis, None, None)
+    fn = shard_map(
+        functools.partial(ulysses_attention, axis_name=sp_axis, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return jax.jit(fn)

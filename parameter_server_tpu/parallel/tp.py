@@ -1,0 +1,72 @@
+"""Tensor-parallel sharding rules for the transformer family.
+
+GSPMD replaces hand-written NCCL tensor-parallel collectives: annotate the
+parameter tree with PartitionSpecs and XLA inserts the all-gathers /
+reduce-scatters over the ICI ``model`` axis (PAPERS.md: GSPMD [V]).
+
+Rules (matching ``models/transformer.py`` param naming):
+- token embedding rows sharded over ``model`` — the PS table partition (the
+  "PS-sharded embeddings" half of the Llama hybrid, BASELINE config #5);
+- attention q/k/v sharded over heads; output projection over heads;
+- MLP up/gate sharded over d_ff, down over d_ff (Megatron-style pairing:
+  column- then row-parallel, one allreduce per block);
+- norms, biases of row-parallel layers, and positional embeddings replicated.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from parameter_server_tpu.parallel.mesh import MODEL_AXIS
+
+
+def _spec_for(path: tuple[str, ...], value: Any) -> P:
+    names = [p for p in path]
+    leaf = names[-1]
+    parent = names[-2] if len(names) >= 2 else ""
+    ndim = getattr(value, "ndim", 0)
+
+    if leaf == "embedding":
+        return P(MODEL_AXIS, None)  # vocab-row sharded (PS table scheme)
+    if leaf == "pos_embedding":
+        return P()
+    if parent in ("q", "k", "v"):
+        if leaf == "kernel":  # [d_model, heads, head_dim]
+            return P(None, MODEL_AXIS, None)
+        return P(MODEL_AXIS, None)  # bias [heads, head_dim]
+    if parent == "o":
+        if leaf == "kernel":  # [heads, head_dim, d_model]
+            return P(MODEL_AXIS, None, None)
+        return P()  # row-parallel bias replicated
+    if parent in ("gate", "up"):
+        if leaf == "kernel":  # [d_model, d_ff]
+            return P(None, MODEL_AXIS)
+        return P(MODEL_AXIS)
+    if parent == "down":
+        if leaf == "kernel":  # [d_ff, d_model]
+            return P(MODEL_AXIS, None)
+        return P()
+    if parent == "lm_head":
+        return P(None, MODEL_AXIS) if ndim == 2 else P(MODEL_AXIS)
+    return P()  # norms and everything else replicated
+
+
+def transformer_param_shardings(params, mesh: Mesh):
+    """Map a transformer param pytree to NamedShardings per the TP rules."""
+
+    def assign(path, value):
+        names = tuple(
+            p.key if hasattr(p, "key") else str(p) for p in path
+        )
+        return NamedSharding(mesh, _spec_for(names, value))
+
+    return jax.tree_util.tree_map_with_path(assign, params)
+
+
+def place_params(params, mesh: Mesh):
+    """Device-put a host param tree onto the mesh per the TP rules."""
+    shardings = transformer_param_shardings(params, mesh)
+    return jax.tree.map(jax.device_put, params, shardings)

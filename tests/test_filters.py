@@ -1,0 +1,112 @@
+"""Wire filter chain: key caching, compression, int8 quantization."""
+
+import numpy as np
+import pytest
+
+from parameter_server_tpu.core.filters import (
+    CompressingFilter,
+    FilterChain,
+    FixingFloatFilter,
+    KeyCachingFilter,
+)
+from parameter_server_tpu.core.messages import Message, Task, TaskKind
+from parameter_server_tpu.ops.quantize import dequantize_int8, quantize_int8
+
+
+def _msg(keys=None, values=()):
+    return Message(
+        task=Task(TaskKind.PUSH, "kv", payload={"table": "w"}),
+        sender="W0",
+        recver="S0",
+        keys=keys,
+        values=list(values),
+    )
+
+
+def test_quantize_roundtrip_error_bound():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 16)).astype(np.float32)
+    q, s = quantize_int8(x, per_row=True)
+    err = np.abs(dequantize_int8(q, s) - x)
+    # max error <= half a quant step per row
+    step = np.max(np.abs(x), axis=1, keepdims=True) / 127.0
+    assert np.all(err <= step * 0.5 + 1e-7)
+
+
+def test_quantize_zero_array():
+    q, s = quantize_int8(np.zeros((4, 4), np.float32))
+    np.testing.assert_array_equal(dequantize_int8(q, s), 0.0)
+
+
+def test_compressing_filter_roundtrip_and_savings():
+    f = CompressingFilter()
+    vals = [np.zeros((1000,), np.float32), np.arange(12, dtype=np.int32)]
+    enc = f.encode(_msg(values=vals))
+    dec = f.decode(enc)
+    np.testing.assert_array_equal(dec.values[0], vals[0])
+    np.testing.assert_array_equal(dec.values[1], vals[1])
+    assert f.bytes_out < f.bytes_in / 10  # zeros compress hard
+
+
+def test_fixing_float_filter_roundtrip():
+    f = FixingFloatFilter()
+    rng = np.random.default_rng(1)
+    vals = [rng.normal(size=(32, 8)).astype(np.float32),
+            np.arange(5, dtype=np.int32)]  # ints pass through untouched
+    dec = f.decode(f.encode(_msg(values=vals)))
+    np.testing.assert_allclose(dec.values[0], vals[0], atol=0.05)
+    np.testing.assert_array_equal(dec.values[1], vals[1])
+    assert dec.values[1].dtype == np.int32
+
+
+def test_key_caching_filter():
+    f = KeyCachingFilter()
+    keys = np.array([3, 5, 9], dtype=np.int32)
+    m1 = f.decode(f.encode(_msg(keys=keys)))
+    np.testing.assert_array_equal(m1.keys, keys)
+    assert f.hits == 0
+    # same keys again: wire message drops them, decode restores
+    enc2 = f.encode(_msg(keys=keys))
+    assert enc2.keys is None and f.hits == 1
+    m2 = f.decode(enc2)
+    np.testing.assert_array_equal(m2.keys, keys)
+    # different keys: cache refresh, no hit
+    keys3 = np.array([1], dtype=np.int32)
+    m3 = f.decode(f.encode(_msg(keys=keys3)))
+    np.testing.assert_array_equal(m3.keys, keys3)
+    assert f.hits == 1
+
+
+def test_filter_chain_end_to_end_through_van():
+    """Full chain riding the LoopbackVan under a real push/pull workload."""
+    from parameter_server_tpu.config import OptimizerConfig, TableConfig
+    from parameter_server_tpu.core.postoffice import Postoffice
+    from parameter_server_tpu.core.van import LoopbackVan
+    from parameter_server_tpu.kv.server import KVServer
+    from parameter_server_tpu.kv.worker import KVWorker
+
+    chain = FilterChain(
+        [KeyCachingFilter(), FixingFloatFilter(), CompressingFilter()]
+    )
+    van = LoopbackVan(filter_chain=chain)
+    try:
+        cfgs = {
+            "w": TableConfig(
+                name="w", rows=256, dim=4,
+                optimizer=OptimizerConfig(kind="sgd", learning_rate=1.0),
+            )
+        }
+        _server = KVServer(Postoffice("S0", van), cfgs, 0, 1)
+        worker = KVWorker(Postoffice("W0", van), cfgs, 1, min_bucket=16)
+        keys = np.array([7, 7, 21], dtype=np.uint64)
+        ts = worker.push("w", keys, np.ones((3, 4), np.float32))
+        worker.wait(ts, timeout=10)
+        w = worker.pull_sync("w", keys, timeout=10)
+        # lr=1 sgd: w = -combined_grad (quantization tolerance)
+        np.testing.assert_allclose(w[0], -2.0, atol=0.1)
+        np.testing.assert_allclose(w[2], -1.0, atol=0.1)
+        # repeated same-key pull hits the key cache
+        worker.pull_sync("w", keys, timeout=10)
+        assert chain.filters[0].hits >= 1
+    finally:
+        van.close()

@@ -1,0 +1,75 @@
+"""Ring attention + Ulysses vs full-attention oracle on the 8-device mesh."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from parameter_server_tpu.ops.ring_attention import (
+    make_ring_attention,
+    reference_attention,
+)
+from parameter_server_tpu.ops.ulysses import make_ulysses_attention
+
+
+def _mesh_sp(n=8):
+    return Mesh(np.asarray(jax.devices()[:n]), ("sp",))
+
+
+def _qkv(rng, b=2, s=64, h=8, d=16):
+    return tuple(
+        jnp.asarray(rng.normal(size=(b, s, h, d)).astype(np.float32))
+        for _ in range(3)
+    )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_reference(causal):
+    rng = np.random.default_rng(0)
+    q, k, v = _qkv(rng)
+    mesh = _mesh_sp()
+    fn = make_ring_attention(mesh, sp_axis="sp", causal=causal)
+    spec = NamedSharding(mesh, P(None, "sp", None, None))
+    qs, ks, vs = (jax.device_put(x, spec) for x in (q, k, v))
+    out = fn(qs, ks, vs)
+    want = reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want), atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_matches_reference(causal):
+    rng = np.random.default_rng(1)
+    q, k, v = _qkv(rng)  # h=8 divisible by sp=8
+    mesh = _mesh_sp()
+    fn = make_ulysses_attention(mesh, sp_axis="sp", causal=causal)
+    spec = NamedSharding(mesh, P(None, "sp", None, None))
+    qs, ks, vs = (jax.device_put(x, spec) for x in (q, k, v))
+    out = fn(qs, ks, vs)
+    want = reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want), atol=2e-5)
+
+
+def test_ulysses_multiple_heads_per_device():
+    """hn > 1: head regrouping must preserve head identity (regression)."""
+    rng = np.random.default_rng(3)
+    q, k, v = _qkv(rng, b=1, s=32, h=16, d=8)  # hn = 16/8 = 2
+    mesh = _mesh_sp()
+    fn = make_ulysses_attention(mesh, sp_axis="sp", causal=True)
+    spec = NamedSharding(mesh, P(None, "sp", None, None))
+    out = fn(*(jax.device_put(x, spec) for x in (q, k, v)))
+    want = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want), atol=2e-5)
+
+
+def test_ring_attention_long_seq_smoke():
+    """Longer-than-memory-per-shard shape sanity (4k tokens over 8 shards)."""
+    rng = np.random.default_rng(2)
+    q, k, v = _qkv(rng, b=1, s=4096, h=2, d=8)
+    mesh = _mesh_sp()
+    fn = make_ring_attention(mesh, sp_axis="sp", causal=True)
+    spec = NamedSharding(mesh, P(None, "sp", None, None))
+    out = fn(*(jax.device_put(x, spec) for x in (q, k, v)))
+    assert out.shape == (1, 4096, 2, 8)
+    assert np.isfinite(np.asarray(out)).all()
