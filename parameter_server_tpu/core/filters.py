@@ -14,6 +14,8 @@ Filters mutate copies of the Message and must satisfy
 
 from __future__ import annotations
 
+import hashlib
+import threading
 import zlib
 from typing import Dict, List, Optional, Tuple
 
@@ -21,7 +23,6 @@ import numpy as np
 
 from parameter_server_tpu.core.messages import Message
 from parameter_server_tpu.ops.quantize import dequantize_int8, quantize_int8
-from parameter_server_tpu.utils.keys import mix64
 
 
 def _msg_copy(msg: Message) -> Message:
@@ -41,6 +42,9 @@ def _msg_copy(msg: Message) -> Message:
 
 
 class Filter:
+    """Filters with mutable per-link state guard it themselves (``_lock``);
+    the Van applies chains concurrently from many sender threads."""
+
     name = "base"
 
     def encode(self, msg: Message) -> Message:
@@ -63,6 +67,7 @@ class KeyCachingFilter(Filter):
     def __init__(self) -> None:
         self._send_cache: Dict[tuple, Tuple[int, np.ndarray]] = {}
         self._recv_cache: Dict[tuple, Tuple[int, np.ndarray]] = {}
+        self._lock = threading.Lock()
         self.hits = 0
 
     @staticmethod
@@ -71,8 +76,13 @@ class KeyCachingFilter(Filter):
 
     @staticmethod
     def _hash(keys: np.ndarray) -> int:
-        h = mix64(np.asarray(keys, np.uint64))
-        return int(h.sum() ^ np.uint64(keys.size))
+        # Order- and multiplicity-sensitive: hash the raw bytes (a permuted
+        # key array must NOT hash-match, or values silently misalign).
+        a = np.ascontiguousarray(keys)
+        d = hashlib.blake2b(
+            a.tobytes(), digest_size=8, person=a.dtype.str.encode()
+        )
+        return int.from_bytes(d.digest(), "little")
 
     def encode(self, msg: Message) -> Message:
         if msg.keys is None:
@@ -81,12 +91,13 @@ class KeyCachingFilter(Filter):
         h = self._hash(msg.keys)
         out = _msg_copy(msg)
         out.task.payload = dict(msg.task.payload, key_hash=h)
-        cached = self._send_cache.get(link)
-        if cached is not None and cached[0] == h:
-            out.keys = None  # receiver restores from its cache
-            self.hits += 1
-        else:
-            self._send_cache[link] = (h, msg.keys)
+        with self._lock:
+            cached = self._send_cache.get(link)
+            if cached is not None and cached[0] == h:
+                out.keys = None  # receiver restores from its cache
+                self.hits += 1
+            else:
+                self._send_cache[link] = (h, msg.keys)
         return out
 
     def decode(self, msg: Message) -> Message:
@@ -95,15 +106,16 @@ class KeyCachingFilter(Filter):
             return msg
         link = self._link(msg)
         out = _msg_copy(msg)
-        if out.keys is None:
-            cached = self._recv_cache.get(link)
-            if cached is None or cached[0] != h:
-                raise RuntimeError(
-                    f"key-cache miss on {link}: receiver lost the key list"
-                )
-            out.keys = cached[1]
-        else:
-            self._recv_cache[link] = (h, out.keys)
+        with self._lock:
+            if out.keys is None:
+                cached = self._recv_cache.get(link)
+                if cached is None or cached[0] != h:
+                    raise RuntimeError(
+                        f"key-cache miss on {link}: receiver lost the key list"
+                    )
+                out.keys = cached[1]
+            else:
+                self._recv_cache[link] = (h, out.keys)
         out.task.payload = {
             k: v for k, v in out.task.payload.items() if k != "key_hash"
         }
@@ -119,6 +131,7 @@ class CompressingFilter(Filter):
         self.level = level
         self.bytes_in = 0
         self.bytes_out = 0
+        self._lock = threading.Lock()  # counters only; codec is stateless
 
     def encode(self, msg: Message) -> Message:
         out = _msg_copy(msg)
@@ -128,8 +141,9 @@ class CompressingFilter(Filter):
             v = np.ascontiguousarray(v)
             raw = v.tobytes()
             comp = zlib.compress(raw, self.level)
-            self.bytes_in += len(raw)
-            self.bytes_out += len(comp)
+            with self._lock:
+                self.bytes_in += len(raw)
+                self.bytes_out += len(comp)
             blobs.append(np.frombuffer(comp, np.uint8))
             meta.append((v.dtype.str, v.shape))
         out.values = blobs
@@ -161,6 +175,7 @@ class FixingFloatFilter(Filter):
     def __init__(self, stochastic: bool = False, seed: int = 0) -> None:
         self.stochastic = stochastic
         self._rng = np.random.default_rng(seed)
+        self._lock = threading.Lock()  # the RNG is not thread-safe
 
     def encode(self, msg: Message) -> Message:
         out = _msg_copy(msg)
@@ -170,10 +185,11 @@ class FixingFloatFilter(Filter):
         for v in msg.values:
             v = np.asarray(v)
             if v.dtype == np.float32 and v.size:
-                q, s = quantize_int8(
-                    v, per_row=v.ndim >= 2, stochastic=self.stochastic,
-                    rng=self._rng,
-                )
+                with self._lock:
+                    q, s = quantize_int8(
+                        v, per_row=v.ndim >= 2, stochastic=self.stochastic,
+                        rng=self._rng,
+                    )
                 vals.append(q)
                 scales.append(s)
                 quantized.append(True)

@@ -1,0 +1,390 @@
+"""Node management: registration, membership, heartbeats, elasticity.
+
+Reference analogue (``src/system/manager.h/.cc`` + ``assigner.h`` +
+``heartbeat_info.h`` [U — reference mount empty, public layout]): the
+scheduler node collects REGISTER messages from launching workers/servers,
+assigns node ids and server key ranges (NodeAssigner), and broadcasts
+ADD_NODE with the full node table; afterwards it watches heartbeats and
+broadcasts REMOVE_NODE when a node misses its window.
+
+Here the same protocol runs over any :class:`~parameter_server_tpu.core.van.Van`
+as CONTROL messages, so it works identically on the in-process LoopbackVan
+(tests / single host) and a future DCN Van.  On a TPU pod the *static* mesh is
+the normal case — `jax.distributed` already provides coordinated startup — so
+this layer's value is (a) API parity, (b) the *elastic* paths: dead-worker
+detection feeding :class:`~parameter_server_tpu.core.clock.ConsistencyController`
+and the WorkloadPool, which XLA/jax.distributed does not give you.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from parameter_server_tpu.core.messages import (
+    SCHEDULER,
+    Message,
+    NodeRole,
+    Task,
+    TaskKind,
+    node_role,
+    server_id,
+    worker_id,
+)
+from parameter_server_tpu.core.postoffice import Customer, Postoffice
+
+#: CONTROL payload "cmd" values — the reference's Control proto verbs.
+REGISTER = "register"
+ADD_NODE = "add_node"
+REMOVE_NODE = "remove_node"
+HEARTBEAT = "heartbeat"
+BARRIER = "barrier"
+
+
+@dataclasses.dataclass
+class NodeInfo:
+    """One row of the scheduler's node table."""
+
+    node_id: str
+    role: NodeRole
+    #: server key range [begin, end) over the global row space (servers only).
+    range_begin: int = 0
+    range_end: int = 0
+    #: wall time of the last heartbeat seen by the scheduler.
+    last_seen: float = 0.0
+    alive: bool = True
+
+
+class NodeAssigner:
+    """Even key-range split over servers (``src/system/assigner.h`` [U]).
+
+    The range here is an abstract [0, key_space) row space; concrete tables
+    scale it to their own row counts via
+    :class:`~parameter_server_tpu.kv.partition.RangePartition`, which uses the
+    same even-contiguous-split rule, so both layers agree on shard boundaries.
+    """
+
+    def __init__(self, key_space: int) -> None:
+        self.key_space = key_space
+
+    def ranges(self, num_servers: int) -> List[tuple[int, int]]:
+        from parameter_server_tpu.kv.partition import RangePartition
+
+        off = RangePartition(self.key_space, num_servers).offsets
+        return [(int(off[s]), int(off[s + 1])) for s in range(num_servers)]
+
+
+class Manager(Customer):
+    """Membership manager; scheduler-role instances own the node table.
+
+    Every process creates one Manager on its Postoffice.  Non-scheduler nodes
+    call :meth:`register_with_scheduler` at startup and then send periodic
+    heartbeats; the scheduler replies to REGISTER once all expected nodes have
+    arrived, broadcasting the complete table (one-shot batch ADD_NODE, which
+    is the reference's startup behavior).
+    """
+
+    CUSTOMER_NAME = "manager"
+
+    def __init__(
+        self,
+        post: Postoffice,
+        *,
+        num_workers: int,
+        num_servers: int,
+        key_space: int = 1 << 20,
+        heartbeat_timeout: float = 5.0,
+    ) -> None:
+        super().__init__(self.CUSTOMER_NAME, post)
+        self.role = node_role(post.node_id)
+        self.num_workers = num_workers
+        self.num_servers = num_servers
+        self.assigner = NodeAssigner(key_space)
+        self.heartbeat_timeout = heartbeat_timeout
+        self._table: Dict[str, NodeInfo] = {}
+        self._table_lock = threading.Lock()
+        self._ready = threading.Event()
+        #: elasticity callbacks: fn(node_id) on death / (re)join.
+        self.on_node_dead: List[Callable[[str], None]] = []
+        self.on_node_added: List[Callable[[str], None]] = []
+        self._monitor_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        if self.role == NodeRole.SCHEDULER:
+            self._register_self()
+
+    # -- startup -------------------------------------------------------------
+    def _register_self(self) -> None:
+        with self._table_lock:
+            self._table[self.post.node_id] = NodeInfo(
+                self.post.node_id, self.role, last_seen=time.monotonic()
+            )
+
+    def register_with_scheduler(
+        self, timeout: Optional[float] = 30.0, *, wait: bool = True
+    ) -> bool:
+        """Send REGISTER; optionally block until the table broadcast arrives.
+
+        ``wait=False`` returns immediately (callers that launch many nodes
+        from one thread register them all first, then ``wait_ready`` each —
+        otherwise node k would block on nodes k+1.. ever registering).
+        """
+        self.submit(
+            [
+                Message(
+                    task=Task(
+                        TaskKind.CONTROL,
+                        self.name,
+                        payload={"cmd": REGISTER, "role": self.role.value},
+                    ),
+                    recver=SCHEDULER,
+                )
+            ]
+        )
+        if not wait:
+            return True
+        return self._ready.wait(timeout)
+
+    def wait_ready(self, timeout: Optional[float] = 30.0) -> bool:
+        """Scheduler: block until all expected nodes have registered."""
+        return self._ready.wait(timeout)
+
+    # -- table access --------------------------------------------------------
+    def nodes(self, role: Optional[NodeRole] = None, alive_only: bool = False):
+        with self._table_lock:
+            rows = [
+                n
+                for n in self._table.values()
+                if (role is None or n.role == role)
+                and (not alive_only or n.alive)
+            ]
+        return sorted(rows, key=lambda n: n.node_id)
+
+    def server_range(self, sid: str) -> tuple[int, int]:
+        with self._table_lock:
+            n = self._table[sid]
+            return (n.range_begin, n.range_end)
+
+    def is_alive(self, node_id: str) -> bool:
+        with self._table_lock:
+            n = self._table.get(node_id)
+            return bool(n and n.alive)
+
+    # -- message handling ----------------------------------------------------
+    def handle_request(self, msg: Message) -> Optional[Message]:
+        cmd = msg.task.payload.get("cmd")
+        if cmd == REGISTER:
+            self._on_register(msg)
+        elif cmd == ADD_NODE:
+            self._on_add_node(msg)
+        elif cmd == REMOVE_NODE:
+            self._on_remove_node(msg)
+        elif cmd == HEARTBEAT:
+            self._on_heartbeat(msg)
+        return msg.reply()
+
+    def _on_register(self, msg: Message) -> None:
+        assert self.role == NodeRole.SCHEDULER, "REGISTER sent to non-scheduler"
+        info = NodeInfo(
+            msg.sender, NodeRole(msg.task.payload["role"]),
+            last_seen=time.monotonic(),
+        )
+        with self._table_lock:
+            self._table[msg.sender] = info
+            workers = sum(
+                1 for n in self._table.values() if n.role == NodeRole.WORKER
+            )
+            servers = sum(
+                1 for n in self._table.values() if n.role == NodeRole.SERVER
+            )
+            complete = workers >= self.num_workers and servers >= self.num_servers
+            if complete:
+                ranges = self.assigner.ranges(self.num_servers)
+                sids = sorted(
+                    n.node_id
+                    for n in self._table.values()
+                    if n.role == NodeRole.SERVER
+                )
+                for sid, (b, e) in zip(sids, ranges):
+                    self._table[sid].range_begin = b
+                    self._table[sid].range_end = e
+            table_rows = [dataclasses.asdict(n) for n in self._table.values()]
+        if complete:
+            self._broadcast_table(table_rows)
+            self._ready.set()
+
+    def _broadcast_table(
+        self, rows: list[dict], targets: Optional[list[str]] = None
+    ) -> None:
+        if targets is None:
+            targets = [r["node_id"] for r in rows if r["node_id"] != SCHEDULER]
+        msgs = [
+            Message(
+                task=Task(
+                    TaskKind.CONTROL,
+                    self.name,
+                    payload={"cmd": ADD_NODE, "table": rows},
+                ),
+                recver=t,
+            )
+            for t in targets
+        ]
+        if msgs:
+            self.submit(msgs)
+
+    def _on_add_node(self, msg: Message) -> None:
+        with self._table_lock:
+            for row in msg.task.payload["table"]:
+                row = dict(row)
+                row["role"] = NodeRole(row["role"])
+                self._table[row["node_id"]] = NodeInfo(**row)
+        for cb in self.on_node_added:
+            for row in msg.task.payload["table"]:
+                cb(row["node_id"] if isinstance(row, dict) else row.node_id)
+        self._ready.set()
+
+    def _on_remove_node(self, msg: Message) -> None:
+        dead = msg.task.payload["node_id"]
+        with self._table_lock:
+            if dead in self._table:
+                self._table[dead].alive = False
+        for cb in self.on_node_dead:
+            cb(dead)
+
+    def _on_heartbeat(self, msg: Message) -> None:
+        recovered = None
+        with self._table_lock:
+            n = self._table.get(msg.sender)
+            if n is not None:
+                n.last_seen = time.monotonic()
+                if not n.alive:
+                    n.alive = True
+                    recovered = dataclasses.asdict(n)
+        if recovered is not None and self.role == NodeRole.SCHEDULER:
+            # Re-join: peers learned REMOVE_NODE, so re-broadcast the row to
+            # everyone and fire the add callbacks (ADD_NODE-on-recovery).
+            with self._table_lock:
+                targets = [
+                    n.node_id
+                    for n in self._table.values()
+                    if n.alive and n.node_id != self.post.node_id
+                ]
+            self._broadcast_table([recovered], targets)
+            for cb in self.on_node_added:
+                cb(msg.sender)
+
+    # -- heartbeats / failure detection --------------------------------------
+    def send_heartbeat(self, stats: Optional[dict] = None) -> int:
+        """Non-scheduler: report liveness (+ optional resource stats)."""
+        return self.submit(
+            [
+                Message(
+                    task=Task(
+                        TaskKind.CONTROL,
+                        self.name,
+                        payload={"cmd": HEARTBEAT, "stats": stats or {}},
+                    ),
+                    recver=SCHEDULER,
+                )
+            ]
+        )
+
+    def check_heartbeats(self) -> List[str]:
+        """Scheduler: mark nodes silent past the timeout dead; broadcast.
+
+        Returns newly dead node ids.  Called from the monitor thread or
+        directly by tests (deterministic failure injection).
+        """
+        now = time.monotonic()
+        newly_dead: List[str] = []
+        with self._table_lock:
+            for n in self._table.values():
+                if n.node_id == self.post.node_id or not n.alive:
+                    continue
+                if now - n.last_seen > self.heartbeat_timeout:
+                    n.alive = False
+                    newly_dead.append(n.node_id)
+            live_targets = [
+                n.node_id
+                for n in self._table.values()
+                if n.alive and n.node_id != self.post.node_id
+            ]
+        for dead in newly_dead:
+            for cb in self.on_node_dead:
+                cb(dead)
+            msgs = [
+                Message(
+                    task=Task(
+                        TaskKind.CONTROL,
+                        self.name,
+                        payload={"cmd": REMOVE_NODE, "node_id": dead},
+                    ),
+                    recver=t,
+                )
+                for t in live_targets
+            ]
+            if msgs:
+                self.submit(msgs)
+        return newly_dead
+
+    def start_monitor(self, interval: float = 1.0) -> None:
+        """Scheduler: poll heartbeats in a daemon thread."""
+
+        def loop() -> None:
+            while not self._stop.wait(interval):
+                self.check_heartbeats()
+
+        self._monitor_thread = threading.Thread(
+            target=loop, name="manager-monitor", daemon=True
+        )
+        self._monitor_thread.start()
+
+    def stop_monitor(self) -> None:
+        self._stop.set()
+        if self._monitor_thread is not None:
+            self._monitor_thread.join(timeout=5)
+
+
+def launch_local_cluster(
+    van,
+    *,
+    num_workers: int,
+    num_servers: int,
+    key_space: int = 1 << 20,
+    heartbeat_timeout: float = 5.0,
+) -> tuple[Manager, Dict[str, Manager], Dict[str, Postoffice]]:
+    """Spin up scheduler + N servers + M workers on one Van (local sim).
+
+    This is the ``script/local.sh`` analogue for in-process tests: every node
+    gets its own Postoffice + Manager, workers/servers register, and the call
+    returns once the scheduler has broadcast the node table.
+    """
+    posts: Dict[str, Postoffice] = {}
+    managers: Dict[str, Manager] = {}
+
+    def make(node_id: str) -> Manager:
+        post = Postoffice(node_id, van)
+        posts[node_id] = post
+        mgr = Manager(
+            post,
+            num_workers=num_workers,
+            num_servers=num_servers,
+            key_space=key_space,
+            heartbeat_timeout=heartbeat_timeout,
+        )
+        managers[node_id] = mgr
+        return mgr
+
+    sched = make(SCHEDULER)
+    for i in range(num_servers):
+        make(server_id(i))
+    for i in range(num_workers):
+        make(worker_id(i))
+    for nid, mgr in managers.items():
+        if nid != SCHEDULER:
+            mgr.register_with_scheduler(wait=False)
+    for nid, mgr in managers.items():
+        if not mgr.wait_ready(timeout=30):
+            raise TimeoutError(f"node {nid} never saw the table broadcast")
+    return sched, managers, posts

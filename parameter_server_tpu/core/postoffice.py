@@ -66,6 +66,7 @@ class Customer:
         self._pending: dict[int, int] = {}
         self._callbacks: dict[int, Callable[[list[Message]], None]] = {}
         self._responses: dict[int, list[Message]] = {}
+        self._kept: set[int] = set()  # timestamps whose responses are retained
         self._executed: dict[str, int] = {}  # per-sender executed task time
         self._cond = threading.Condition()
         post.register(self)
@@ -75,18 +76,28 @@ class Customer:
         self,
         msgs: list[Message],
         callback: Optional[Callable[[list[Message]], None]] = None,
+        *,
+        keep_responses: bool = False,
     ) -> int:
         """Send one logical task as ``msgs`` (already sliced per receiver).
 
         All messages share the newly assigned timestamp; the task completes
         when every receiver has responded.  Returns the timestamp.
+
+        Response bodies are retained only when ``keep_responses`` is set (the
+        caller then MUST drain them via :meth:`take_responses`) or while a
+        callback is pending — otherwise fire-and-forget tasks (pushes,
+        heartbeats) would pin every reply payload for the process lifetime.
         """
         ts = self._ts.next()
         with self._cond:
             self._pending[ts] = len(msgs)
-            self._responses[ts] = []
+            if keep_responses or callback is not None:
+                self._responses[ts] = []
             if callback is not None:
                 self._callbacks[ts] = callback
+            if keep_responses:
+                self._kept.add(ts)
         undeliverable = []
         for m in msgs:
             m.task.customer = self.name
@@ -112,16 +123,23 @@ class Customer:
             return ts not in self._pending
 
     def responses(self, ts: int) -> list[Message]:
-        """Collected response messages for a completed task."""
+        """Collected response messages for a completed kept task."""
         with self._cond:
             return list(self._responses.get(ts, []))
+
+    def take_responses(self, ts: int) -> list[Message]:
+        """Drain (and forget) the responses of a ``keep_responses`` task."""
+        with self._cond:
+            self._kept.discard(ts)
+            return self._responses.pop(ts, [])
 
     def _on_response(self, msg: Message) -> None:
         ts = msg.task.time
         with self._cond:
             if ts not in self._pending:
                 return  # late/duplicate response
-            self._responses[ts].append(msg)
+            if ts in self._responses:
+                self._responses[ts].append(msg)
             self._pending[ts] -= 1
             if self._pending[ts] <= 0:
                 self._finish_locked(ts)
@@ -129,7 +147,10 @@ class Customer:
     def _finish_locked(self, ts: int) -> None:
         del self._pending[ts]
         cb = self._callbacks.pop(ts, None)
-        responses = self._responses.get(ts, [])
+        if ts in self._kept:
+            responses = self._responses.get(ts, [])
+        else:
+            responses = self._responses.pop(ts, [])
         self._cond.notify_all()
         if cb is not None:
             # Fire outside the lock to allow callbacks to re-submit.

@@ -80,10 +80,10 @@ class LoopbackVan(Van):
         self._endpoints: dict[str, _Endpoint] = {}
         self._disconnected: set[str] = set()
         self._lock = threading.Lock()
+        # Filters guard their own mutable state (per-filter locks), so the
+        # chain runs concurrently across sender threads — compression /
+        # quantization of large payloads must not serialize all traffic.
         self.filter_chain = filter_chain
-        # filters hold mutable per-link state (caches, byte counters, RNG);
-        # serialized separately from the endpoint lock to keep send cheap
-        self._filter_lock = threading.Lock()
         #: counters for the dashboard (reference network_usage.h role).
         self.sent_messages = 0
         self.dropped_messages = 0
@@ -108,8 +108,7 @@ class LoopbackVan(Van):
         with self._lock:
             self.sent_messages += 1
         if self.filter_chain is not None:
-            with self._filter_lock:
-                msg = self.filter_chain.decode(self.filter_chain.encode(msg))
+            msg = self.filter_chain.decode(self.filter_chain.encode(msg))
         ep.inbox.put(msg)
         return True
 

@@ -26,13 +26,16 @@ from parameter_server_tpu.config import OptimizerConfig
 from parameter_server_tpu.core.messages import Message, Task, TaskKind, server_id
 from parameter_server_tpu.core.postoffice import Customer, Postoffice
 from parameter_server_tpu.kv.optim import ServerOptimizer, make_optimizer
+from parameter_server_tpu.kv.partition import RangePartition
 
 
 def segment_offsets(total: int, num_servers: int) -> np.ndarray:
-    """num_servers+1 element offsets; server s owns [off[s], off[s+1])."""
-    base, rem = divmod(total, num_servers)
-    sizes = [base + (1 if s < rem else 0) for s in range(num_servers)]
-    return np.cumsum([0] + sizes)
+    """num_servers+1 element offsets; server s owns [off[s], off[s+1]).
+
+    Delegates to :class:`RangePartition` so every layer (manager key ranges,
+    sparse tables, dense segments) splits by the identical rule.
+    """
+    return RangePartition(total, num_servers).offsets
 
 
 class DenseKVServer(Customer):
@@ -127,7 +130,7 @@ class DenseKVWorker(Customer):
             )
             for s in range(self.num_servers)
         ]
-        ts = self.submit(msgs)
+        ts = self.submit(msgs, keep_responses=True)
         self._pull_meta[ts] = table
         return ts
 
@@ -137,7 +140,7 @@ class DenseKVWorker(Customer):
         table = self._pull_meta.pop(ts)
         off = self.offsets[table]
         out = np.zeros(off[-1], np.float32)
-        for resp in self.responses(ts):
+        for resp in self.take_responses(ts):
             s = int(resp.sender[1:])
             out[off[s] : off[s + 1]] = resp.values[0]
         return out

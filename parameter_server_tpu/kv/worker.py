@@ -108,7 +108,7 @@ class KVWorker(Customer):
                     keys=local,
                 )
             )
-        ts = self.submit(msgs)
+        ts = self.submit(msgs, keep_responses=True)
         self._pull_plans[ts] = {
             "order": order,
             "inverse": inverse,
@@ -129,7 +129,7 @@ class KVWorker(Customer):
         plan = self._pull_plans.pop(ts)
         cfg = self.table_cfgs[plan["table"]]
         uniq_rows = np.zeros((plan["n_slots"], cfg.dim), dtype=cfg.dtype)
-        for resp in self.responses(ts):
+        for resp in self.take_responses(ts):
             seg = plan["order"][resp.sender]
             uniq_rows[seg] = resp.values[0]
         out = uniq_rows[plan["inverse"]]

@@ -175,9 +175,17 @@ class AsyncDenseLearner:
         *,
         timeout: float = 120.0,
     ) -> list[float]:
+        errors: list[BaseException] = []
+
+        def guarded(*args):
+            try:
+                self._worker_loop(*args)
+            except BaseException as e:  # propagate to run()'s caller
+                errors.append(e)
+
         threads = [
             threading.Thread(
-                target=self._worker_loop,
+                target=guarded,
                 args=(kv, batch_fns[i], i, steps_per_worker, timeout),
                 name=f"dense-worker-{i}",
             )
@@ -187,6 +195,8 @@ class AsyncDenseLearner:
             t.start()
         for t in threads:
             t.join()
+        if errors:
+            raise errors[0]
         return list(self._losses)
 
     def _worker_loop(self, kv, batch_fn, index, steps, timeout):

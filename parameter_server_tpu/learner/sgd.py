@@ -206,9 +206,17 @@ class AsyncLRLearner:
         timeout: float = 60.0,
     ) -> list[float]:
         """Run all workers to completion; returns per-iteration mean losses."""
+        errors: list[BaseException] = []
+
+        def guarded(*args):
+            try:
+                self._worker_loop(*args)
+            except BaseException as e:  # propagate to run()'s caller
+                errors.append(e)
+
         threads = [
             threading.Thread(
-                target=self._worker_loop,
+                target=guarded,
                 args=(w, batch_fns[i], i, steps_per_worker, timeout),
                 name=f"sgd-worker-{i}",
             )
@@ -218,6 +226,8 @@ class AsyncLRLearner:
             t.start()
         for t in threads:
             t.join()
+        if errors:
+            raise errors[0]
         return list(self._losses)
 
     def _worker_loop(

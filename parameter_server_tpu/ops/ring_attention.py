@@ -111,7 +111,7 @@ def make_ring_attention(
     mesh: Mesh, *, sp_axis: str, causal: bool = False
 ) -> "jax.stages.Wrapped":
     """jit-able wrapper: full [B, S, H, D] arrays sharded on S over sp_axis."""
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     spec = P(None, sp_axis, None, None)
 

@@ -56,7 +56,7 @@ def ulysses_attention(
 
 
 def make_ulysses_attention(mesh: Mesh, *, sp_axis: str, causal: bool = False):
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     spec = P(None, sp_axis, None, None)
     fn = shard_map(

@@ -48,10 +48,12 @@ def test_request_response_roundtrip():
             recver="S0",
             values=[np.array([1.0, 2.0])],
         )
-        ts = client.submit([msg])
+        ts = client.submit([msg], keep_responses=True)
         assert client.wait(ts, timeout=5)
-        (resp,) = client.responses(ts)
+        (resp,) = client.take_responses(ts)
         np.testing.assert_allclose(resp.values[0], [2.0, 4.0])
+        # drained: fire-and-forget semantics afterwards (no retention leak)
+        assert client.responses(ts) == []
     finally:
         van.close()
 

@@ -1,0 +1,151 @@
+"""L1 node management: registration, key ranges, heartbeats, elasticity.
+
+Mirrors the reference's integration style (N nodes over loopback transport,
+SURVEY.md §4) but as deterministic in-process tests with explicit heartbeat
+polling instead of wall-clock threads.
+"""
+
+import time
+
+from parameter_server_tpu.core.clock import ConsistencyController
+from parameter_server_tpu.core.manager import (
+    Manager,
+    NodeAssigner,
+    launch_local_cluster,
+)
+from parameter_server_tpu.core.messages import NodeRole, worker_id
+from parameter_server_tpu.core.van import LoopbackVan
+from parameter_server_tpu.config import ConsistencyConfig, ConsistencyMode
+from parameter_server_tpu.learner.workload import WorkloadPool
+
+
+def test_node_assigner_even_split():
+    a = NodeAssigner(10)
+    assert a.ranges(3) == [(0, 4), (4, 7), (7, 10)]
+    assert a.ranges(1) == [(0, 10)]
+    # ranges tile the space exactly
+    rs = a.ranges(4)
+    assert rs[0][0] == 0 and rs[-1][1] == 10
+    assert all(rs[i][1] == rs[i + 1][0] for i in range(3))
+
+
+def test_cluster_registration_broadcasts_table():
+    van = LoopbackVan()
+    try:
+        sched, managers, _ = launch_local_cluster(
+            van, num_workers=3, num_servers=2
+        )
+        # every node sees the full table with assigned server ranges
+        for mgr in managers.values():
+            assert mgr.wait_ready(5)
+            servers = mgr.nodes(NodeRole.SERVER)
+            assert [s.node_id for s in servers] == ["S0", "S1"]
+            b0, e0 = mgr.server_range("S0")
+            b1, e1 = mgr.server_range("S1")
+            assert b0 == 0 and e0 == b1 and e1 == sched.assigner.key_space
+            assert len(mgr.nodes(NodeRole.WORKER)) == 3
+    finally:
+        van.close()
+
+
+def test_heartbeat_death_detection_and_callbacks():
+    van = LoopbackVan()
+    try:
+        sched, managers, _ = launch_local_cluster(
+            van, num_workers=2, num_servers=1, heartbeat_timeout=0.2
+        )
+        dead_seen = []
+        sched.on_node_dead.append(dead_seen.append)
+
+        # all nodes heartbeat once; then W1 goes silent
+        for nid, mgr in managers.items():
+            if nid != "H":
+                mgr.send_heartbeat({"cpu": 0.5})
+        time.sleep(0.3)
+        managers[worker_id(0)].send_heartbeat()
+        managers["S0"].send_heartbeat()
+        time.sleep(0.05)
+
+        newly_dead = sched.check_heartbeats()
+        assert newly_dead == ["W1"]
+        assert dead_seen == ["W1"]
+        assert not sched.is_alive("W1")
+        assert sched.is_alive("W0")
+        # surviving nodes learn about the death via REMOVE_NODE broadcast
+        deadline = time.time() + 5
+        while time.time() < deadline and managers["W0"].is_alive("W1"):
+            time.sleep(0.01)
+        assert not managers["W0"].is_alive("W1")
+
+        # W1 recovers: heartbeat marks it alive again on the scheduler
+        managers[worker_id(1)].send_heartbeat()
+        deadline = time.time() + 5
+        while time.time() < deadline and not sched.is_alive("W1"):
+            time.sleep(0.01)
+        assert sched.is_alive("W1")
+    finally:
+        van.close()
+
+
+def test_death_unblocks_ssp_clock():
+    """A dead worker must not stall the SSP bound (Executor::ReplaceNode)."""
+    van = LoopbackVan()
+    try:
+        sched, managers, _ = launch_local_cluster(
+            van, num_workers=2, num_servers=1, heartbeat_timeout=0.1
+        )
+        ctrl = ConsistencyController(
+            ConsistencyConfig(ConsistencyMode.SSP, max_delay=1), num_workers=2
+        )
+        worker_index = {"W0": 0, "W1": 1}
+        sched.on_node_dead.append(
+            lambda nid: nid in worker_index
+            and ctrl.mark_dead(worker_index[nid])
+        )
+
+        # W0 runs ahead; W1 never advances -> W0 blocked at t=2 under SSP(1)
+        ctrl.finish_iteration(0)
+        ctrl.finish_iteration(0)
+        assert not ctrl.wait_turn(0, 3, timeout=0.05)
+
+        # W1 dies (no heartbeats); scheduler detects, callback frees the bound
+        time.sleep(0.15)
+        managers["W0"].send_heartbeat()
+        managers["S0"].send_heartbeat()
+        time.sleep(0.05)
+        assert "W1" in sched.check_heartbeats()
+        assert ctrl.wait_turn(0, 3, timeout=2.0)
+    finally:
+        van.close()
+
+
+def test_workload_pool_basic_and_reassignment():
+    pool = WorkloadPool(["f0", "f1", "f2", "f3"])
+    w0 = pool.get("W0")
+    w1 = pool.get("W1")
+    assert {w0.payload, w1.payload} == {"f0", "f1"}
+    assert pool.finish("W0", w0.workload_id)
+    # dead worker's outstanding shard returns to the pool
+    requeued = pool.mark_dead("W1")
+    assert requeued == [w1.workload_id]
+    assert pool.get("W1") is None  # dead workers get nothing
+    picked = [pool.get("W0") for _ in range(3)]
+    assert [p.payload for p in picked if p] == ["f2", "f3", "f1"]
+    for p in picked:
+        pool.finish("W0", p.workload_id)
+    assert pool.all_done()
+
+
+def test_workload_pool_straggler_duplication():
+    pool = WorkloadPool(["a", "b", "c", "d"], straggler_factor=1.5, min_history=3)
+    slow = pool.get("W0")
+    for _ in range(3):
+        w = pool.get("W1")
+        pool.finish("W1", w.workload_id)
+    # make the outstanding workload look old without real sleeping
+    slow.started_at -= 10.0
+    dup = pool.get("W1")
+    assert dup is not None and dup.workload_id == slow.workload_id
+    assert pool.finish("W1", dup.workload_id)  # speculative copy wins
+    assert not pool.finish("W0", slow.workload_id)  # original loses
+    assert pool.all_done()
