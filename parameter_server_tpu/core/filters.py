@@ -185,11 +185,14 @@ class FixingFloatFilter(Filter):
         for v in msg.values:
             v = np.asarray(v)
             if v.dtype == np.float32 and v.size:
-                with self._lock:
-                    q, s = quantize_int8(
-                        v, per_row=v.ndim >= 2, stochastic=self.stochastic,
-                        rng=self._rng,
-                    )
+                if self.stochastic:  # only the RNG path needs the lock
+                    with self._lock:
+                        q, s = quantize_int8(
+                            v, per_row=v.ndim >= 2, stochastic=True,
+                            rng=self._rng,
+                        )
+                else:
+                    q, s = quantize_int8(v, per_row=v.ndim >= 2)
                 vals.append(q)
                 scales.append(s)
                 quantized.append(True)

@@ -80,10 +80,12 @@ class LoopbackVan(Van):
         self._endpoints: dict[str, _Endpoint] = {}
         self._disconnected: set[str] = set()
         self._lock = threading.Lock()
-        # Filters guard their own mutable state (per-filter locks), so the
-        # chain runs concurrently across sender threads — compression /
-        # quantization of large payloads must not serialize all traffic.
+        # Filter traffic serializes per LINK (sender, recver), not globally:
+        # key-caching's encode/decode protocol needs wire-FIFO per link
+        # (which real transports give for free), while traffic on different
+        # links — the hot concurrent case — encodes in parallel.
         self.filter_chain = filter_chain
+        self._link_locks: dict[tuple, threading.Lock] = {}
         #: counters for the dashboard (reference network_usage.h role).
         self.sent_messages = 0
         self.dropped_messages = 0
@@ -108,7 +110,11 @@ class LoopbackVan(Van):
         with self._lock:
             self.sent_messages += 1
         if self.filter_chain is not None:
-            msg = self.filter_chain.decode(self.filter_chain.encode(msg))
+            link = (msg.sender, msg.recver)
+            with self._lock:
+                link_lock = self._link_locks.setdefault(link, threading.Lock())
+            with link_lock:
+                msg = self.filter_chain.decode(self.filter_chain.encode(msg))
         ep.inbox.put(msg)
         return True
 

@@ -15,6 +15,7 @@ async push/pull of dense layers):
 
 from __future__ import annotations
 
+import functools
 import threading
 from typing import Callable, Dict, Optional, Tuple
 
@@ -28,6 +29,7 @@ from parameter_server_tpu.core.clock import ConsistencyController
 from parameter_server_tpu.kv.dense import DenseKVWorker, PytreeCodec
 from parameter_server_tpu.parallel import mesh as mesh_lib
 from parameter_server_tpu.utils import metrics as metrics_lib
+from parameter_server_tpu.utils.threads import run_threads
 
 Batch = Tuple[np.ndarray, np.ndarray]
 BatchFn = Callable[[], Batch]
@@ -175,28 +177,16 @@ class AsyncDenseLearner:
         *,
         timeout: float = 120.0,
     ) -> list[float]:
-        errors: list[BaseException] = []
-
-        def guarded(*args):
-            try:
-                self._worker_loop(*args)
-            except BaseException as e:  # propagate to run()'s caller
-                errors.append(e)
-
-        threads = [
-            threading.Thread(
-                target=guarded,
-                args=(kv, batch_fns[i], i, steps_per_worker, timeout),
-                name=f"dense-worker-{i}",
-            )
-            for i, kv in enumerate(self.kv_workers)
-        ]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        if errors:
-            raise errors[0]
+        run_threads(
+            [
+                functools.partial(
+                    self._worker_loop, kv, batch_fns[i], i, steps_per_worker,
+                    timeout,
+                )
+                for i, kv in enumerate(self.kv_workers)
+            ],
+            name="dense-worker",
+        )
         return list(self._losses)
 
     def _worker_loop(self, kv, batch_fn, index, steps, timeout):

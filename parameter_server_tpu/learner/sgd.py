@@ -17,6 +17,7 @@ Two drivers over the same model math (``models/linear.py``):
 
 from __future__ import annotations
 
+import functools
 import threading
 from typing import Callable, Dict, Optional, Tuple
 
@@ -36,6 +37,7 @@ from parameter_server_tpu.kv.worker import KVWorker
 from parameter_server_tpu.models import linear
 from parameter_server_tpu.utils import metrics as metrics_lib
 from parameter_server_tpu.utils.keys import HashLocalizer, localize_to_slots
+from parameter_server_tpu.utils.threads import run_threads
 
 Batch = Tuple[np.ndarray, np.ndarray]  # (keys [B, nnz], labels [B])
 BatchFn = Callable[[], Batch]
@@ -206,28 +208,16 @@ class AsyncLRLearner:
         timeout: float = 60.0,
     ) -> list[float]:
         """Run all workers to completion; returns per-iteration mean losses."""
-        errors: list[BaseException] = []
-
-        def guarded(*args):
-            try:
-                self._worker_loop(*args)
-            except BaseException as e:  # propagate to run()'s caller
-                errors.append(e)
-
-        threads = [
-            threading.Thread(
-                target=guarded,
-                args=(w, batch_fns[i], i, steps_per_worker, timeout),
-                name=f"sgd-worker-{i}",
-            )
-            for i, w in enumerate(self.workers)
-        ]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        if errors:
-            raise errors[0]
+        run_threads(
+            [
+                functools.partial(
+                    self._worker_loop, w, batch_fns[i], i, steps_per_worker,
+                    timeout,
+                )
+                for i, w in enumerate(self.workers)
+            ],
+            name="sgd-worker",
+        )
         return list(self._losses)
 
     def _worker_loop(

@@ -73,6 +73,10 @@ class WorkloadPool:
             straggler = self._find_straggler_locked(worker)
             if straggler is not None:
                 straggler.assigned_to.append(worker)
+                # restart the clock: the winner's duration must reflect the
+                # latest assignment, or the median ratchets upward and
+                # disables straggler detection over time
+                straggler.started_at = time.monotonic()
                 return straggler
         return None
 

@@ -1,0 +1,53 @@
+"""Thread fan-out with error propagation.
+
+Every learner runs N worker loops in threads and must surface the first
+failure to the caller instead of letting it die with the thread (Python's
+default excepthook just prints).  One helper, used by the SGD, dense, and
+BCD learners alike.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional, Sequence
+
+
+class ErrorGroup:
+    """Collects exceptions from spawned threads; re-raises the first."""
+
+    def __init__(self) -> None:
+        self._errors: List[BaseException] = []
+        self._lock = threading.Lock()
+
+    def spawn(self, fn: Callable, *args, name: Optional[str] = None) -> threading.Thread:
+        def guarded() -> None:
+            try:
+                fn(*args)
+            except BaseException as e:
+                with self._lock:
+                    self._errors.append(e)
+
+        t = threading.Thread(target=guarded, name=name)
+        t.start()
+        return t
+
+    def check(self) -> None:
+        """Raise the first recorded error, if any."""
+        with self._lock:
+            if self._errors:
+                raise self._errors[0]
+
+
+def run_threads(
+    targets: Sequence[Callable[[], None]],
+    *,
+    name: str = "worker",
+) -> None:
+    """Run callables in parallel threads; join all; raise the first error."""
+    group = ErrorGroup()
+    threads = [
+        group.spawn(fn, name=f"{name}-{i}") for i, fn in enumerate(targets)
+    ]
+    for t in threads:
+        t.join()
+    group.check()

@@ -1,0 +1,154 @@
+"""DARLIN block coordinate descent: golden equivalence + convergence + KKT.
+
+Strategy per SURVEY.md §4: golden-convergence — the Van-based pipeline under
+BSP-equivalent settings must match a single-process numpy implementation of
+the same delayed block proximal gradient update exactly (same block order);
+bounded delay (tau>1, multi-worker) must reach a comparable objective.
+"""
+
+import numpy as np
+import pytest
+
+from parameter_server_tpu.core.van import LoopbackVan
+from parameter_server_tpu.core.postoffice import Postoffice
+from parameter_server_tpu.learner.bcd import (
+    BCDConfig,
+    BlockPartition,
+    DarlinScheduler,
+    DarlinServer,
+    DarlinWorker,
+)
+
+F, B, N, NNZ = 64, 4, 512, 8
+
+
+def _make_data(seed: int, n: int = N):
+    rng = np.random.default_rng(seed)
+    indices = rng.integers(0, F, size=(n, NNZ)).astype(np.int64)
+    w_true = np.zeros(F)
+    w_true[: F // 8] = rng.normal(0, 1.5, F // 8)  # few informative features
+    margin = w_true[indices].sum(axis=1) - w_true.sum() * NNZ / F
+    labels = (rng.random(n) < 1 / (1 + np.exp(-margin))).astype(np.float32)
+    indptr = np.arange(n + 1, dtype=np.int64) * NNZ
+    return indptr, indices.ravel(), labels
+
+
+def _numpy_darlin(shards, cfg: BCDConfig, block_orders):
+    """Single-process reference: same update rule, sequential blocks."""
+    blocks = BlockPartition(cfg.num_features, cfg.num_blocks)
+    w = np.zeros(cfg.num_features)
+    margins = [np.zeros(len(labels)) for _, _, labels in shards]
+    rows_cols = []
+    for indptr, indices, _ in shards:
+        row_of = np.repeat(np.arange(len(indptr) - 1), np.diff(indptr))
+        rows_cols.append((row_of, indices))
+    for order in block_orders:
+        for b in order:
+            lo, hi = blocks.block_range(b)
+            g = np.zeros(hi - lo)
+            u = np.zeros(hi - lo)
+            for (rows, cols), margin, (_, _, labels) in zip(
+                rows_cols, margins, shards
+            ):
+                sel = (cols >= lo) & (cols < hi)
+                p = 1 / (1 + np.exp(-margin))
+                resid = (p - labels)[rows[sel]]
+                np.add.at(g, cols[sel] - lo, resid)
+                rc = np.bincount(rows[sel], minlength=len(margin))
+                maxrow = max(rc.max() if rc.size else 0, 1)
+                np.add.at(u, cols[sel] - lo, 0.25 * maxrow)
+            ueff = u + cfg.l2 + 1e-12
+            z = w[lo:hi] - g / ueff
+            z = np.sign(z) * np.maximum(np.abs(z) - cfg.l1 / ueff, 0.0)
+            d = np.clip(z - w[lo:hi], -cfg.delta_max, cfg.delta_max)
+            inactive = (w[lo:hi] == 0.0) & (np.abs(g) <= cfg.l1 - cfg.kkt_delta)
+            d = np.where(~inactive, d, 0.0)
+            w[lo:hi] += d
+            for (rows, cols), i in zip(rows_cols, range(len(margins))):
+                sel = (cols >= lo) & (cols < hi)
+                np.add.at(margins[i], rows[sel], d[cols[sel] - lo])
+    return w, margins
+
+
+def _build_cluster(cfg, shards, num_servers=1):
+    van = LoopbackVan()
+    posts = {}
+    blocks = BlockPartition(cfg.num_features, cfg.num_blocks)
+    servers = []
+    for s in range(num_servers):
+        posts[f"S{s}"] = Postoffice(f"S{s}", van)
+        servers.append(
+            DarlinServer(
+                posts[f"S{s}"], cfg, blocks, s, num_servers, len(shards)
+            )
+        )
+    workers = []
+    for i, (indptr, indices, labels) in enumerate(shards):
+        posts[f"W{i}"] = Postoffice(f"W{i}", van)
+        workers.append(
+            DarlinWorker(
+                posts[f"W{i}"], cfg, blocks, num_servers, indptr, indices, labels
+            )
+        )
+    return van, workers, servers
+
+
+def test_darlin_matches_numpy_reference_exactly():
+    cfg = BCDConfig(num_features=F, num_blocks=B, l1=0.5, tau=1)
+    shards = [_make_data(0)]
+    van, workers, servers = _build_cluster(cfg, shards)
+    try:
+        sched = DarlinScheduler(cfg, workers, servers, seed=7)
+        sched.run(3)
+        orders = np.random.default_rng(7)
+        block_orders = [orders.permutation(B) for _ in range(3)]
+        w_ref, margins_ref = _numpy_darlin(shards, cfg, block_orders)
+        np.testing.assert_allclose(
+            sched.dense_weights(), w_ref, rtol=1e-4, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            workers[0].scores(), margins_ref[0], rtol=1e-4, atol=1e-4
+        )
+    finally:
+        van.close()
+
+
+def test_darlin_objective_decreases_and_kkt_filters():
+    # l1 in sum-loss units: noise-feature |g| ~ sqrt(count)/2 ~ 4 here
+    cfg = BCDConfig(num_features=F, num_blocks=B, l1=6.0, tau=1)
+    shards = [_make_data(1)]
+    van, workers, servers = _build_cluster(cfg, shards)
+    try:
+        sched = DarlinScheduler(cfg, workers, servers, seed=3)
+        hist = sched.run(6)
+        objs = [h["objective"] for h in hist]
+        assert objs[-1] < objs[0]
+        assert all(o2 <= o1 + 1e-6 for o1, o2 in zip(objs, objs[1:]))
+        # strong L1: most noise features end inactive, few weights nonzero
+        assert hist[-1]["active"] < F
+        assert 0 < hist[-1]["nnz"] < F // 2
+    finally:
+        van.close()
+
+
+@pytest.mark.parametrize("tau", [2, 3])
+def test_darlin_bounded_delay_multiworker(tau):
+    cfg = BCDConfig(num_features=F, num_blocks=B, l1=0.5, tau=tau)
+    shards = [_make_data(10), _make_data(11), _make_data(12)]
+    van, workers, servers = _build_cluster(cfg, shards, num_servers=2)
+    try:
+        sched = DarlinScheduler(cfg, workers, servers, seed=5)
+        hist = sched.run(5)
+        assert hist[-1]["objective"] < hist[0]["objective"]
+        # compare against the sequential reference end-objective: bounded
+        # delay may lag slightly but must land in the same neighborhood
+        cfg1 = BCDConfig(num_features=F, num_blocks=B, l1=0.5, tau=1)
+        van2, workers2, servers2 = _build_cluster(cfg1, shards, num_servers=2)
+        try:
+            sched2 = DarlinScheduler(cfg1, workers2, servers2, seed=5)
+            hist2 = sched2.run(5)
+            assert hist[-1]["objective"] <= hist2[-1]["objective"] * 1.2 + 0.05
+        finally:
+            van2.close()
+    finally:
+        van.close()
