@@ -1,1 +1,31 @@
-"""data subpackage."""
+"""Host data input pipeline: parsers, readers, synthetic generators.
+
+Reference: ``src/data/`` (text parsers, SlotReader, StreamReader) [U],
+SURVEY.md #18.  Text parsing runs in native C++ (``native/src/textparse.cc``)
+with bit-identical numpy fallbacks.
+"""
+
+from parameter_server_tpu.data.reader import (
+    SlotReader,
+    StreamReader,
+    criteo_log_transform,
+)
+from parameter_server_tpu.data.synthetic import SyntheticCTR, SyntheticDLRM
+from parameter_server_tpu.data.text import (
+    CSRBatch,
+    parse_criteo,
+    parse_libsvm,
+    write_libsvm,
+)
+
+__all__ = [
+    "CSRBatch",
+    "SlotReader",
+    "StreamReader",
+    "SyntheticCTR",
+    "SyntheticDLRM",
+    "criteo_log_transform",
+    "parse_criteo",
+    "parse_libsvm",
+    "write_libsvm",
+]

@@ -299,20 +299,21 @@ class DarlinWorker(Customer):
         row_of_nnz = np.repeat(
             np.arange(self.num_examples, dtype=np.int32), np.diff(indptr)
         )
-        self._block_rows: List[np.ndarray] = []
-        self._block_cols: List[np.ndarray] = []
+        # device-resident once: block tasks reuse these every epoch
+        self._block_rows: List[jnp.ndarray] = []
+        self._block_cols: List[jnp.ndarray] = []
         for b in range(blocks.num_blocks):
             lo, hi = blocks.block_range(b)
             sel = (indices >= lo) & (indices < hi)
-            self._block_rows.append(np.ascontiguousarray(row_of_nnz[sel]))
+            self._block_rows.append(jnp.asarray(row_of_nnz[sel]))
             self._block_cols.append(
-                np.ascontiguousarray((indices[sel] - lo).astype(np.int32))
+                jnp.asarray((indices[sel] - lo).astype(np.int32))
             )
 
     def block_task(self, b: int, it: int, timeout: float = 60.0) -> None:
         """One DARLIN block step: grad -> push -> pull delta -> margin."""
-        rows = jnp.asarray(self._block_rows[b])
-        cols = jnp.asarray(self._block_cols[b])
+        rows = self._block_rows[b]
+        cols = self._block_cols[b]
         n = self.blocks.block_size(b)
         with self._margin_lock:
             margin = self.margin

@@ -28,7 +28,10 @@ class Workload:
     payload: Any = None
     #: workers currently assigned (>1 only under speculative duplication).
     assigned_to: List[str] = dataclasses.field(default_factory=list)
-    started_at: float = 0.0
+    #: per-assignment start time, keyed by worker — durations are measured
+    #: from the *winner's own* assignment so speculative duplicates never
+    #: corrupt the completion-time history.
+    started_at: Dict[str, float] = dataclasses.field(default_factory=dict)
     done: bool = False
     completed_by: Optional[str] = None
 
@@ -68,15 +71,12 @@ class WorkloadPool:
                 wid = self._pending.pop(0)
                 w = self._workloads[wid]
                 w.assigned_to.append(worker)
-                w.started_at = time.monotonic()
+                w.started_at[worker] = time.monotonic()
                 return w
             straggler = self._find_straggler_locked(worker)
             if straggler is not None:
                 straggler.assigned_to.append(worker)
-                # restart the clock: the winner's duration must reflect the
-                # latest assignment, or the median ratchets upward and
-                # disables straggler detection over time
-                straggler.started_at = time.monotonic()
+                straggler.started_at[worker] = time.monotonic()
                 return straggler
         return None
 
@@ -92,7 +92,7 @@ class WorkloadPool:
                 not w.done
                 and len(live) == 1  # exactly the one straggling assignee
                 and worker not in w.assigned_to
-                and now - w.started_at > cutoff
+                and now - w.started_at.get(live[0], now) > cutoff
             ):
                 return w
         return None
@@ -111,7 +111,11 @@ class WorkloadPool:
             # out completed work.
             if workload_id in self._pending:
                 self._pending.remove(workload_id)
-            self._durations.append(time.monotonic() - w.started_at)
+            # duration from THIS worker's assignment; a finish from a worker
+            # with no recorded start (requeue race) adds no history
+            start = w.started_at.get(worker)
+            if start is not None:
+                self._durations.append(time.monotonic() - start)
             return True
 
     # -- elasticity ----------------------------------------------------------

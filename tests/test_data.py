@@ -1,0 +1,227 @@
+"""Data IO layer: text parsers (native/Python parity), readers, e2e train.
+
+Mirrors the reference's parser unit tests (SURVEY.md §4: text parser +
+SlotReader gtests) plus parity assertions the reference never needed (two
+parser implementations here: C++ and numpy fallback).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from parameter_server_tpu import native
+from parameter_server_tpu.data import reader as reader_lib
+from parameter_server_tpu.data import text as text_lib
+from parameter_server_tpu.utils.keys import PAD_KEY, mix64
+
+LIBSVM_SAMPLE = b"""# comment line
+1 3:0.5 17:1.25 100000:2
+0 5:1 6:-0.75
+1 12345678901:3.5e-2  # trailing comment
+0
+
+-1 7:1e3
+"""
+
+
+def _py_parse(fn, *args, **kw):
+    """Run a parse with the native path disabled."""
+    native._cache.clear()
+    os.environ["PS_NO_NATIVE"] = "1"
+    try:
+        return fn(*args, **kw)
+    finally:
+        del os.environ["PS_NO_NATIVE"]
+        native._cache.clear()
+
+
+def test_libsvm_fallback_basics():
+    b = _py_parse(text_lib.parse_libsvm, LIBSVM_SAMPLE)
+    assert b.rows == 5
+    np.testing.assert_array_equal(b.labels, [1, 0, 1, 0, -1])
+    np.testing.assert_array_equal(b.indptr, [0, 3, 5, 6, 6, 7])
+    assert b.indices[0] == 3 and b.values[1] == pytest.approx(1.25)
+    assert b.indices[5] == 12345678901
+    assert b.values[5] == pytest.approx(3.5e-2)
+
+
+def test_libsvm_native_matches_python():
+    if native.load("textparse") is None:
+        pytest.skip("no native toolchain")
+    rng = np.random.default_rng(0)
+    lines = []
+    for _ in range(500):
+        nnz = rng.integers(0, 40)
+        feats = " ".join(
+            f"{rng.integers(0, 1 << 48)}:{rng.normal():.6g}" for _ in range(nnz)
+        )
+        lines.append(f"{rng.integers(0, 2)} {feats}")
+    data = ("\n".join(lines) + "\n").encode()
+    a = text_lib.parse_libsvm(data)
+    b = _py_parse(text_lib.parse_libsvm, data)
+    np.testing.assert_array_equal(a.labels, b.labels)
+    np.testing.assert_array_equal(a.indptr, b.indptr)
+    np.testing.assert_array_equal(a.indices, b.indices)
+    np.testing.assert_allclose(a.values, b.values, rtol=1e-6)
+
+
+def test_criteo_native_matches_python_and_hashes():
+    data = (
+        b"1\t" + b"\t".join(b"%d" % i for i in range(13)) + b"\t"
+        + b"\t".join(b"%02x" % i for i in range(26)) + b"\n"
+        + b"0\t\t2\t\t4\t5\t6\t7\t8\t9\t10\t11\t12\t\tdeadbeef"
+        + b"\t" * 25 + b"\n"
+    )
+    lp, dp, kp = _py_parse(text_lib.parse_criteo, data)
+    assert lp.shape == (2,) and dp.shape == (2, 13) and kp.shape == (2, 26)
+    assert dp[1, 0] == 0.0 and dp[1, 1] == 2.0  # missing dense -> 0
+    # slot salting: same raw value in different slots -> different keys
+    assert kp[1, 1] != kp[1, 2]
+    # hash parity with utils.keys.mix64
+    want = mix64(np.uint64(0xDEADBEEF) ^ np.uint64(1), 0)
+    assert kp[1, 0] == want
+    if native.load("textparse") is not None:
+        ln, dn, kn = text_lib.parse_criteo(data)
+        np.testing.assert_array_equal(ln, lp)
+        np.testing.assert_array_equal(dn, dp)
+        np.testing.assert_array_equal(kn, kp)
+
+
+def test_parser_parity_edge_cases():
+    """Comment lines, blank CRLF lines, junk/overflow hex — both paths agree."""
+    svm = b"# header comment\n1 3:0.5\n   # indented comment\n0 5:1\n"
+    a = _py_parse(text_lib.parse_libsvm, svm)
+    assert a.rows == 2
+    if native.load("textparse") is not None:
+        b = text_lib.parse_libsvm(svm)
+        np.testing.assert_array_equal(a.labels, b.labels)
+        np.testing.assert_array_equal(a.indptr, b.indptr)
+    tsv = (
+        b"1\t" + b"\t".join(b"%d" % i for i in range(13)) + b"\t"
+        + b"\t".join(b"%02x" % i for i in range(26)) + b"\n"
+        + b"\r\n"  # blank CRLF line: not a row
+        + b"0\t" + b"\t" * 13 + b"12345678901234567"  # 17 hex digits: wraps
+        + b"\t12z9"  # junk suffix: hex prefix 0x12
+        + b"\t" * 24 + b"\n"
+    )
+    lp, dp, kp = _py_parse(text_lib.parse_criteo, tsv)
+    assert lp.shape == (2,)
+    assert kp[1, 0] == text_lib.hash_cat(
+        np.uint64(0x2345678901234567), 0
+    )  # top digit wrapped off
+    assert kp[1, 1] == text_lib.hash_cat(np.uint64(0x12), 1)
+    if native.load("textparse") is not None:
+        ln, dn, kn = text_lib.parse_criteo(tsv)
+        np.testing.assert_array_equal(ln, lp)
+        np.testing.assert_array_equal(kn, kp)
+
+
+def test_mix64_abi_parity():
+    lib = native.load("textparse")
+    if lib is None:
+        pytest.skip("no native toolchain")
+    xs = np.random.default_rng(1).integers(0, 1 << 63, size=32, dtype=np.uint64)
+    for x in xs:
+        assert lib.ps_mix64(int(x), 7) == int(mix64(x, 7))
+
+
+def test_to_fixed_nnz_pads_and_truncates():
+    b = _py_parse(text_lib.parse_libsvm, LIBSVM_SAMPLE)
+    keys, vals, labels = b.to_fixed_nnz(2)
+    assert keys.shape == (5, 2)
+    assert keys[0, 0] == 3 and keys[0, 1] == 17  # truncated row
+    assert keys[3, 0] == PAD_KEY and vals[3, 0] == 0.0  # empty row padded
+    np.testing.assert_array_equal(labels, b.labels)
+
+
+def test_write_parse_roundtrip(tmp_path):
+    b = _py_parse(text_lib.parse_libsvm, LIBSVM_SAMPLE)
+    p = tmp_path / "out.libsvm"
+    text_lib.write_libsvm(str(p), b)
+    b2 = text_lib.parse_libsvm(p.read_bytes())
+    np.testing.assert_array_equal(b.indices, b2.indices)
+    np.testing.assert_allclose(b.values, b2.values, rtol=1e-5)
+
+
+def _write_synthetic_libsvm(path, rows, seed=0, nnz=8, key_space=1 << 16):
+    rng = np.random.default_rng(seed)
+    with open(path, "w") as f:
+        for _ in range(rows):
+            keys = rng.integers(0, key_space, size=nnz)
+            label = rng.integers(0, 2)
+            f.write(f"{label} " + " ".join(f"{k}:1" for k in keys) + "\n")
+
+
+def test_slot_reader_caches(tmp_path):
+    data = tmp_path / "train.libsvm"
+    _write_synthetic_libsvm(str(data), 300)
+    cache = tmp_path / "cache"
+    r = reader_lib.SlotReader(
+        [str(data)], cache_dir=str(cache), chunk_bytes=4096
+    )
+    full = r.read_all()
+    assert full.rows == 300
+    cached_files = list(cache.glob("slot_*.npz"))
+    assert cached_files, "cache not written"
+    # second pass hits the cache and returns identical data
+    full2 = r.read_all()
+    np.testing.assert_array_equal(full.indices, full2.indices)
+    np.testing.assert_array_equal(full.indptr, full2.indptr)
+
+
+def test_stream_reader_batches(tmp_path):
+    data = tmp_path / "s.libsvm"
+    _write_synthetic_libsvm(str(data), 250)
+    sr = reader_lib.StreamReader(
+        [str(data)], batch_size=64, max_nnz=8, epochs=2, chunk_bytes=2048
+    )
+    batches = list(sr)
+    # 500 rows over 2 epochs -> 7 full batches of 64
+    assert len(batches) == (250 * 2) // 64
+    for keys, vals, labels in batches:
+        assert keys.shape == (64, 8) and labels.shape == (64,)
+        assert keys.dtype == np.uint64
+
+
+def test_stream_reader_criteo(tmp_path):
+    lines = []
+    rng = np.random.default_rng(3)
+    for i in range(40):
+        dense = "\t".join(str(int(x)) for x in rng.integers(0, 100, 13))
+        cats = "\t".join(f"{int(x):x}" for x in rng.integers(0, 1 << 32, 26))
+        lines.append(f"{i % 2}\t{dense}\t{cats}")
+    p = tmp_path / "day0.tsv"
+    p.write_text("\n".join(lines) + "\n")
+    sr = reader_lib.StreamReader(
+        [str(p)], batch_size=16, format="criteo", epochs=1
+    )
+    batches = list(sr)
+    assert len(batches) == 2
+    keys, dense, labels = batches[0]
+    assert keys.shape == (16, 26) and dense.shape == (16, 13)
+
+
+def test_e2e_train_from_libsvm_file(tmp_path):
+    """Full slice: text file -> StreamReader -> LocalLRTrainer, loss drops."""
+    from parameter_server_tpu.config import OptimizerConfig, TableConfig
+    from parameter_server_tpu.learner.sgd import LocalLRTrainer
+
+    # learnable synthetic: label = (sum of key parities) threshold
+    path = tmp_path / "train.libsvm"
+    rng = np.random.default_rng(7)
+    with open(path, "w") as f:
+        for _ in range(2000):
+            keys = rng.integers(0, 512, size=6)
+            label = int(np.sum(keys % 7 == 0) > 0)
+            f.write(f"{label} " + " ".join(f"{k}:1" for k in keys) + "\n")
+    cfg = TableConfig(
+        name="w", rows=4096, dim=1,
+        optimizer=OptimizerConfig(kind="adagrad", learning_rate=0.2),
+    )
+    tr = LocalLRTrainer(cfg, min_bucket=256)
+    losses = []
+    sr = reader_lib.StreamReader([str(path)], batch_size=256, max_nnz=6, epochs=4)
+    for keys, _vals, labels in sr:
+        losses.append(tr.step(keys, labels))
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]) - 0.05

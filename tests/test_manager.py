@@ -143,7 +143,7 @@ def test_workload_pool_straggler_duplication():
         w = pool.get("W1")
         pool.finish("W1", w.workload_id)
     # make the outstanding workload look old without real sleeping
-    slow.started_at -= 10.0
+    slow.started_at["W0"] -= 10.0
     dup = pool.get("W1")
     assert dup is not None and dup.workload_id == slow.workload_id
     assert pool.finish("W1", dup.workload_id)  # speculative copy wins
