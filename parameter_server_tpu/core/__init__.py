@@ -1,1 +1,37 @@
-"""core subpackage."""
+"""Core: messages, consistency clocks, Van/Postoffice, filters, membership.
+
+The process-level runtime of the PS (SURVEY.md L1-L3).  Tensor traffic on
+ICI never touches this layer (XLA collectives move it); these objects carry
+control-plane and DCN-plane traffic.
+"""
+
+from parameter_server_tpu.core.messages import (
+    Message,
+    NodeRole,
+    Task,
+    TaskKind,
+    server_id,
+    worker_id,
+)
+from parameter_server_tpu.core.van import LoopbackVan, Van
+
+__all__ = [
+    "LoopbackVan",
+    "Message",
+    "NodeRole",
+    "Task",
+    "TaskKind",
+    "Van",
+    "server_id",
+    "worker_id",
+]
+
+
+def __getattr__(name):
+    # TcpVan requires the native toolchain; import lazily so toolchain-less
+    # hosts can still use the rest of core.
+    if name == "TcpVan":
+        from parameter_server_tpu.core.tcp_van import TcpVan
+
+        return TcpVan
+    raise AttributeError(name)

@@ -1,0 +1,303 @@
+"""TcpVan: the DCN-plane transport over native TCP sockets.
+
+Reference analogue: ``src/system/van.h/.cc`` — ZeroMQ sockets, a node table,
+and a receive thread [U] (SURVEY.md #2).  The socket/framing/thread core is
+native C++ (``native/src/tcpvan.cc``, loaded via ctypes); this module owns
+what the reference kept in C++ around protobuf: routing (node id -> address),
+message serialization, per-link filter chains, and handler dispatch.
+
+Design notes:
+
+- One ``TcpVan`` per *process*; multiple logical nodes (scheduler + servers +
+  workers colocated on a host) may bind on it, exactly like LoopbackVan.
+- Wire format per frame: ``[u32 header_len][pickle header][raw arrays...]``
+  where the header carries Task fields + array dtype/shape manifests and the
+  arrays ride as raw bytes (the SArray zero-copy role: numpy views are taken
+  straight from the received buffer, no per-array pickling).
+- Filters (key caching / compression / quantization — core/filters.py) apply
+  per link on the encoded Message before serialization, matching the
+  reference's RemoteNode filter stacks.
+- Unreachable/unknown destinations drop the message and return False — same
+  contract as LoopbackVan, which the failure-detection layer builds on.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import pickle
+import socket
+import struct
+import threading
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from parameter_server_tpu import native
+from parameter_server_tpu.core.messages import Message, Task, TaskKind
+from parameter_server_tpu.core.van import Van
+
+_u8p = ctypes.POINTER(ctypes.c_uint8)
+
+
+def _lib() -> ctypes.CDLL:
+    lib = native.load("tcpvan", required=True)
+    if not getattr(lib, "_ps_sigs", False):
+        lib.ps_van_new.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int)
+        ]
+        lib.ps_van_new.restype = ctypes.c_void_p
+        lib.ps_van_connect.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+        lib.ps_van_send.argtypes = [ctypes.c_void_p, ctypes.c_int, _u8p, ctypes.c_int64]
+        lib.ps_van_recv.argtypes = [
+            ctypes.c_void_p, ctypes.c_double, ctypes.POINTER(_u8p),
+            ctypes.POINTER(ctypes.c_int),
+        ]
+        lib.ps_van_recv.restype = ctypes.c_int64
+        lib.ps_van_free.argtypes = [_u8p]
+        lib.ps_van_disconnect.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.ps_van_close.argtypes = [ctypes.c_void_p]
+        lib.ps_van_port.argtypes = [ctypes.c_void_p]
+        lib.ps_van_bytes_sent.argtypes = [ctypes.c_void_p]
+        lib.ps_van_bytes_sent.restype = ctypes.c_int64
+        lib.ps_van_bytes_recv.argtypes = [ctypes.c_void_p]
+        lib.ps_van_bytes_recv.restype = ctypes.c_int64
+        lib._ps_sigs = True
+    return lib
+
+
+# ------------------------------------------------------------ serialization
+
+
+def serialize_message(msg: Message) -> bytes:
+    """Message -> wire bytes.  Arrays ride raw after a pickled header."""
+    arrays = []
+    manifests = []
+    for a in ([msg.keys] if msg.keys is not None else []) + list(msg.values):
+        a = np.ascontiguousarray(a)
+        arrays.append(a)
+        manifests.append((str(a.dtype), a.shape))
+    header = pickle.dumps(
+        {
+            "task": (
+                msg.task.kind.value,
+                msg.task.customer,
+                msg.task.time,
+                msg.task.wait_time,
+                msg.task.payload,
+            ),
+            "sender": msg.sender,
+            "recver": msg.recver,
+            "is_request": msg.is_request,
+            "has_keys": msg.keys is not None,
+            "manifests": manifests,
+        },
+        protocol=pickle.HIGHEST_PROTOCOL,
+    )
+    parts = [struct.pack("<I", len(header)), header]
+    parts += [a.tobytes() for a in arrays]
+    return b"".join(parts)
+
+
+def deserialize_message(buf: memoryview) -> Message:
+    (hlen,) = struct.unpack_from("<I", buf, 0)
+    head = pickle.loads(bytes(buf[4 : 4 + hlen]))
+    kind, customer, time_, wait_time, payload = head["task"]
+    off = 4 + hlen
+    arrays = []
+    for dtype, shape in head["manifests"]:
+        n = int(np.prod(shape)) if shape else 1
+        nbytes = n * np.dtype(dtype).itemsize
+        arr = np.frombuffer(buf, dtype=dtype, count=n, offset=off).reshape(shape)
+        arrays.append(arr)
+        off += nbytes
+    keys = arrays.pop(0) if head["has_keys"] else None
+    return Message(
+        task=Task(
+            kind=TaskKind(kind), customer=customer, time=time_,
+            wait_time=wait_time, payload=payload,
+        ),
+        sender=head["sender"],
+        recver=head["recver"],
+        keys=keys,
+        values=arrays,
+        is_request=head["is_request"],
+    )
+
+
+def _resolve(host: str) -> str:
+    """inet_addr in the native core needs a numeric IPv4."""
+    return socket.gethostbyname(host)
+
+
+# ------------------------------------------------------------------- TcpVan
+
+
+class TcpVan(Van):
+    """Cross-host Van over the native TCP core.
+
+    Usage::
+
+        van = TcpVan()                      # binds an ephemeral port
+        van.bind("S0", server_handler)      # local node(s)
+        van.add_route("W0", ("10.0.0.2", 9001))
+        van.send(msg)                       # routes local or remote
+    """
+
+    def __init__(
+        self,
+        host: str = "0.0.0.0",
+        port: int = 0,
+        *,
+        filter_chain=None,
+        advertise_host: Optional[str] = None,
+    ) -> None:
+        self._lib = _lib()
+        actual = ctypes.c_int()
+        self._van = self._lib.ps_van_new(
+            host.encode(), port, ctypes.byref(actual)
+        )
+        if not self._van:
+            raise OSError(f"TcpVan: cannot bind {host}:{port}")
+        self.port = actual.value
+        self.advertise_host = advertise_host or "127.0.0.1"
+        self.filter_chain = filter_chain
+        self._handlers: Dict[str, Callable[[Message], None]] = {}
+        self._routes: Dict[str, Tuple[str, int]] = {}
+        self._conns: Dict[Tuple[str, int], int] = {}
+        self._link_locks: Dict[tuple, threading.Lock] = {}
+        self._lock = threading.Lock()
+        self._closed = threading.Event()
+        self.sent_messages = 0
+        self.dropped_messages = 0
+        self._dispatch = threading.Thread(
+            target=self._dispatch_loop, name=f"tcpvan-dispatch-{self.port}",
+            daemon=True,
+        )
+        self._dispatch.start()
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return (self.advertise_host, self.port)
+
+    # -- routing -------------------------------------------------------------
+    def add_route(self, node_id: str, address: Tuple[str, int]) -> None:
+        with self._lock:
+            self._routes[node_id] = address
+
+    def routes(self) -> Dict[str, Tuple[str, int]]:
+        with self._lock:
+            return dict(self._routes)
+
+    def bind(self, node_id: str, handler: Callable[[Message], None]) -> None:
+        with self._lock:
+            if node_id in self._handlers:
+                raise ValueError(f"node {node_id!r} already bound")
+            self._handlers[node_id] = handler
+
+    # -- send ----------------------------------------------------------------
+    def send(self, msg: Message) -> bool:
+        with self._lock:
+            local = self._handlers.get(msg.recver)
+        if local is not None:
+            # same-process fast path: no serialization, match LoopbackVan
+            with self._lock:
+                self.sent_messages += 1
+            local(msg)
+            return True
+        with self._lock:
+            addr = self._routes.get(msg.recver)
+        if addr is None:
+            with self._lock:
+                self.dropped_messages += 1
+            return False
+        if self.filter_chain is not None:
+            with self._lock:
+                ll = self._link_locks.setdefault(
+                    (msg.sender, msg.recver), threading.Lock()
+                )
+            with ll:
+                msg = self.filter_chain.encode(msg)
+        data = serialize_message(msg)
+        conn = self._get_conn(addr)
+        if conn is None:
+            with self._lock:
+                self.dropped_messages += 1
+            return False
+        # zero-copy: point at the bytes' buffer (send only reads it)
+        buf = ctypes.cast(ctypes.c_char_p(data), _u8p)
+        rc = self._lib.ps_van_send(self._van, conn, buf, len(data))
+        with self._lock:
+            if rc == 0:
+                self.sent_messages += 1
+            else:
+                self.dropped_messages += 1
+                self._conns.pop(addr, None)  # force reconnect next time
+        return rc == 0
+
+    def _get_conn(self, addr: Tuple[str, int]) -> Optional[int]:
+        with self._lock:
+            conn = self._conns.get(addr)
+        if conn is not None:
+            return conn
+        try:
+            ip = _resolve(addr[0])
+        except OSError:
+            return None
+        conn = self._lib.ps_van_connect(self._van, ip.encode(), addr[1])
+        if conn < 0:
+            return None
+        with self._lock:
+            # lost race: keep the first connection
+            existing = self._conns.setdefault(addr, conn)
+        return existing
+
+    # -- receive -------------------------------------------------------------
+    def _dispatch_loop(self) -> None:
+        while not self._closed.is_set():
+            data = _u8p()
+            conn = ctypes.c_int()
+            n = self._lib.ps_van_recv(
+                self._van, 0.2, ctypes.byref(data), ctypes.byref(conn)
+            )
+            if n == -1:
+                continue  # timeout tick: re-check closed flag
+            if n == -3:
+                return
+            if n == -2:
+                continue  # peer closed; routes stay (reconnect on send)
+            try:
+                raw = ctypes.string_at(data, n) if n else b""
+            finally:
+                self._lib.ps_van_free(data)
+            try:
+                msg = deserialize_message(memoryview(raw))
+            except Exception:
+                continue  # corrupt frame: drop (wire-level noise tolerance)
+            if self.filter_chain is not None:
+                with self._lock:
+                    ll = self._link_locks.setdefault(
+                        (msg.sender, msg.recver), threading.Lock()
+                    )
+                with ll:
+                    msg = self.filter_chain.decode(msg)
+            with self._lock:
+                handler = self._handlers.get(msg.recver)
+            if handler is not None:
+                handler(msg)
+
+    # -- stats / lifecycle ---------------------------------------------------
+    def bytes_sent(self) -> int:
+        return int(self._lib.ps_van_bytes_sent(self._van))
+
+    def bytes_recv(self) -> int:
+        return int(self._lib.ps_van_bytes_recv(self._van))
+
+    def close(self) -> None:
+        if self._closed.is_set():
+            return
+        # dispatch thread exits on its next timeout tick BEFORE the native
+        # handle is destroyed (it dereferences the handle in ps_van_recv)
+        self._closed.set()
+        self._dispatch.join(timeout=5)
+        self._lib.ps_van_close(self._van)
+        self._van = None

@@ -66,25 +66,49 @@ class SlotReader:
         if cache_dir:
             os.makedirs(cache_dir, exist_ok=True)
 
-    def _cache_path(self, path: str, idx: int) -> Optional[str]:
-        if not self.cache_dir:
-            return None
+    def _file_tag(self, path: str) -> str:
         st = os.stat(path)
-        tag = hashlib.sha1(
-            f"{os.path.abspath(path)}:{st.st_size}:{st.st_mtime_ns}:{idx}:"
+        return hashlib.sha1(
+            f"{os.path.abspath(path)}:{st.st_size}:{st.st_mtime_ns}:"
             f"{self.chunk_bytes}".encode()
         ).hexdigest()[:16]
-        return os.path.join(self.cache_dir, f"slot_{tag}.npz")
+
+    def _cache_path(self, tag: str, idx: int) -> Optional[str]:
+        if not self.cache_dir:
+            return None
+        return os.path.join(self.cache_dir, f"slot_{tag}_{idx}.npz")
+
+    def _manifest_path(self, tag: str) -> str:
+        return os.path.join(self.cache_dir, f"slot_{tag}.manifest")  # type: ignore[arg-type]
+
+    def _load_chunk(self, cpath: str) -> text_lib.CSRBatch:
+        z = np.load(cpath)
+        return text_lib.CSRBatch(
+            z["labels"], z["indptr"], z["indices"], z["values"]
+        )
 
     def chunks(self) -> Iterator[text_lib.CSRBatch]:
         for path in self.files:
+            tag = self._file_tag(path) if self.cache_dir else ""
+            # warm-cache fast path: the manifest records the chunk count, so
+            # later passes (BCD iterates many times) never re-read the raw
+            # text at all
+            if self.cache_dir:
+                mpath = self._manifest_path(tag)
+                if os.path.exists(mpath):
+                    with open(mpath) as mf:
+                        n_chunks = int(mf.read().strip())
+                    paths = [self._cache_path(tag, i) for i in range(n_chunks)]
+                    if all(os.path.exists(p) for p in paths):  # type: ignore[arg-type]
+                        for p in paths:
+                            yield self._load_chunk(p)  # type: ignore[arg-type]
+                        continue
+            n_chunks = 0
             for idx, raw in enumerate(_read_chunks(path, self.chunk_bytes)):
-                cpath = self._cache_path(path, idx)
+                n_chunks = idx + 1
+                cpath = self._cache_path(tag, idx)
                 if cpath and os.path.exists(cpath):
-                    z = np.load(cpath)
-                    yield text_lib.CSRBatch(
-                        z["labels"], z["indptr"], z["indices"], z["values"]
-                    )
+                    yield self._load_chunk(cpath)
                     continue
                 batch = text_lib.parse_libsvm(raw)
                 if cpath:
@@ -99,6 +123,11 @@ class SlotReader:
                     )
                     os.replace(tmp, cpath)
                 yield batch
+            if self.cache_dir:
+                tmp = self._manifest_path(tag) + f".{os.getpid()}.tmp"
+                with open(tmp, "w") as mf:
+                    mf.write(str(n_chunks))
+                os.replace(tmp, self._manifest_path(tag))
 
     def read_all(self) -> text_lib.CSRBatch:
         """Concatenate every chunk (small datasets / tests)."""

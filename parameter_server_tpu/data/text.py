@@ -36,17 +36,18 @@ def _lib() -> Optional[ctypes.CDLL]:
     if lib is not None and not getattr(lib, "_ps_sigs", False):
         lib.ps_libsvm_count.argtypes = [
             ctypes.c_char_p, ctypes.c_int64, ctypes.c_int, _i64p, _i64p,
+            _i64p, _i64p,
         ]
         lib.ps_libsvm_fill.argtypes = [
-            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int, _i64p, _i64p,
             _f32p, _i64p, _u64p, _f32p,
         ]
         lib.ps_criteo_count.argtypes = [
-            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int, _i64p,
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int, _i64p, _i64p,
         ]
         lib.ps_criteo_fill.argtypes = [
-            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int, ctypes.c_int,
-            ctypes.c_int, _f32p, _f32p, _u64p,
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int, _i64p,
+            ctypes.c_int, ctypes.c_int, _f32p, _f32p, _u64p,
         ]
         lib.ps_mix64.argtypes = [ctypes.c_uint64, ctypes.c_uint64]
         lib.ps_mix64.restype = ctypes.c_uint64
@@ -118,17 +119,66 @@ def _auto_threads() -> int:
 def _parse_libsvm_native(lib: ctypes.CDLL, data: bytes, nthreads: int) -> CSRBatch:
     rows = ctypes.c_int64()
     nnz = ctypes.c_int64()
-    lib.ps_libsvm_count(data, len(data), nthreads, ctypes.byref(rows), ctypes.byref(nnz))
+    chunk_rows = np.zeros(max(nthreads, 1), dtype=np.int64)
+    chunk_nnz = np.zeros(max(nthreads, 1), dtype=np.int64)
+    lib.ps_libsvm_count(
+        data, len(data), nthreads, ctypes.byref(rows), ctypes.byref(nnz),
+        chunk_rows.ctypes.data_as(_i64p), chunk_nnz.ctypes.data_as(_i64p),
+    )
     labels = np.empty(rows.value, dtype=np.float32)
     indptr = np.zeros(rows.value + 1, dtype=np.int64)
     indices = np.empty(nnz.value, dtype=np.uint64)
     values = np.empty(nnz.value, dtype=np.float32)
     lib.ps_libsvm_fill(
         data, len(data), nthreads,
+        chunk_rows.ctypes.data_as(_i64p), chunk_nnz.ctypes.data_as(_i64p),
         labels.ctypes.data_as(_f32p), indptr.ctypes.data_as(_i64p),
         indices.ctypes.data_as(_u64p), values.ctypes.data_as(_f32p),
     )
     return CSRBatch(labels, indptr, indices, values)
+
+
+def _float_prefix(tok: bytes) -> tuple[float, int]:
+    """Mirror of the C parser's numeric subset: ``[-+]?d*[.d*][eE[-+]?d*]``.
+
+    Returns ``(value, chars_consumed)``; consumed == 0 when the mantissa has
+    no digits (malformed).  Used by both fallback parsers so accept/skip
+    decisions match the native path token for token (no nan/inf, no
+    locale, junk tolerated only after the numeric prefix).
+    """
+    i, n = 0, len(tok)
+    neg = False
+    if i < n and tok[i : i + 1] in (b"+", b"-"):
+        neg = tok[i : i + 1] == b"-"
+        i += 1
+    v = 0.0
+    digits = 0
+    while i < n and 48 <= tok[i] <= 57:
+        v = v * 10.0 + (tok[i] - 48)
+        i += 1
+        digits += 1
+    if i < n and tok[i : i + 1] == b".":
+        i += 1
+        scale = 0.1
+        while i < n and 48 <= tok[i] <= 57:
+            v += (tok[i] - 48) * scale
+            scale *= 0.1
+            i += 1
+            digits += 1
+    if digits == 0:
+        return 0.0, 0
+    if i < n and tok[i : i + 1] in (b"e", b"E"):
+        i += 1
+        eneg = False
+        if i < n and tok[i : i + 1] in (b"+", b"-"):
+            eneg = tok[i : i + 1] == b"-"
+            i += 1
+        ex = 0
+        while i < n and 48 <= tok[i] <= 57:
+            ex = ex * 10 + (tok[i] - 48)
+            i += 1
+        v *= 10.0 ** (-ex if eneg else ex)
+    return (-v if neg else v), i
 
 
 def _parse_libsvm_py(data: bytes) -> CSRBatch:
@@ -138,15 +188,23 @@ def _parse_libsvm_py(data: bytes) -> CSRBatch:
         if not line:
             continue
         parts = line.split()
-        labels.append(float(parts[0]))
+        label, _ = _float_prefix(parts[0])  # junk label -> 0.0, row kept
+        labels.append(label)
         for tok in parts[1:]:
-            if b":" in tok:
-                k, v = tok.split(b":", 1)
-                indices.append(int(k))
-                values.append(float(v))
+            # accept/skip rules identical to the native parse_feature():
+            # key must be all digits; value (if present) must be a fully-
+            # consumed numeric; malformed tokens are skipped whole.
+            k, _, v = tok.partition(b":")
+            if not k.isdigit():
+                continue
+            if v or tok.endswith(b":"):
+                val, used = _float_prefix(v)
+                if used == 0 or used != len(v):
+                    continue
             else:
-                indices.append(int(tok))
-                values.append(1.0)
+                val = 1.0
+            indices.append(int(k))
+            values.append(val)
         indptr.append(len(indices))
     return CSRBatch(
         np.asarray(labels, np.float32),
@@ -179,12 +237,17 @@ def parse_criteo(
     if lib is not None:
         rows = ctypes.c_int64()
         nt = nthreads or _auto_threads()
-        lib.ps_criteo_count(data, len(data), nt, ctypes.byref(rows))
+        chunk_rows = np.zeros(max(nt, 1), dtype=np.int64)
+        lib.ps_criteo_count(
+            data, len(data), nt, ctypes.byref(rows),
+            chunk_rows.ctypes.data_as(_i64p),
+        )
         labels = np.empty(rows.value, dtype=np.float32)
         dense = np.empty((rows.value, N_DENSE), dtype=np.float32)
         keys = np.empty((rows.value, N_CAT), dtype=np.uint64)
         lib.ps_criteo_fill(
-            data, len(data), nt, N_DENSE, N_CAT,
+            data, len(data), nt, chunk_rows.ctypes.data_as(_i64p),
+            N_DENSE, N_CAT,
             labels.ctypes.data_as(_f32p), dense.ctypes.data_as(_f32p),
             keys.ctypes.data_as(_u64p),
         )
@@ -217,15 +280,12 @@ def _parse_criteo_py(data: bytes) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         if not line.strip():
             continue
         f = line.rstrip(b"\r").split(b"\t")
-        labels.append(float(f[0]) if f[0] else 0.0)
+        labels.append(_float_prefix(f[0])[0])
         d = np.zeros(N_DENSE, dtype=np.float32)
         for i in range(N_DENSE):
             tok = f[1 + i] if 1 + i < len(f) else b""
             if tok:
-                try:
-                    d[i] = float(tok)
-                except ValueError:
-                    pass
+                d[i] = _float_prefix(tok)[0]  # junk-suffix tolerant
         dense.append(d)
         raw = np.empty(N_CAT, dtype=np.uint64)
         for i in range(N_CAT):

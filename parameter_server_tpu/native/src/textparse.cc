@@ -46,18 +46,31 @@ inline const char* skip_ws(const char* p, const char* end) {
 
 inline double parse_float(const char* p, const char* end, const char** out) {
   // Hand-rolled strtod subset: [-+]?digits[.digits][eE[-+]digits].
-  // Avoids strtod's locale + NUL-termination requirements on a mmap'd buffer.
+  // Avoids strtod's locale + NUL-termination requirements on a mmap'd
+  // buffer.  No digits in the mantissa => *out == input p (no consumption),
+  // which callers use to detect malformed fields.  The Python fallback
+  // (_float_prefix in data/text.py) mirrors this function bit-for-bit.
+  const char* start = p;
   bool neg = false;
   if (p < end && (*p == '+' || *p == '-')) neg = (*p++ == '-');
   double v = 0.0;
-  while (p < end && *p >= '0' && *p <= '9') v = v * 10.0 + (*p++ - '0');
+  int digits = 0;
+  while (p < end && *p >= '0' && *p <= '9') {
+    v = v * 10.0 + (*p++ - '0');
+    ++digits;
+  }
   if (p < end && *p == '.') {
     ++p;
     double scale = 0.1;
     while (p < end && *p >= '0' && *p <= '9') {
       v += (*p++ - '0') * scale;
       scale *= 0.1;
+      ++digits;
     }
+  }
+  if (digits == 0) {
+    *out = start;
+    return 0.0;
   }
   if (p < end && (*p == 'e' || *p == 'E')) {
     ++p;
@@ -121,6 +134,48 @@ struct LibsvmCounts {
   std::vector<int64_t> rows, nnz;
 };
 
+inline bool at_token_end(const char* p, const char* end) {
+  return p >= end || *p == ' ' || *p == '\t' || *p == '\r' || *p == '\n';
+}
+
+inline const char* skip_token(const char* p, const char* end) {
+  while (!at_token_end(p, end)) ++p;
+  return p;
+}
+
+// Parse one "key:value" feature token. Returns true iff well-formed: key is
+// all digits, optional ":value" where value is a non-empty numeric, and the
+// token terminates at whitespace/EOL.  Malformed tokens are skipped whole
+// (never partially consumed — guarantees forward progress; the Python
+// fallback applies the same accept/skip rule, keeping parity).
+inline bool parse_feature(const char* p, const char* end, const char** out,
+                          uint64_t* key, float* val) {
+  const char* start = p;
+  const char* q;
+  *key = parse_u64(p, end, &q);
+  if (q == p) {  // no digits: malformed (qid:, comments handled by caller)
+    *out = skip_token(p, end);
+    return false;
+  }
+  p = q;
+  *val = 1.0f;
+  if (p < end && *p == ':') {
+    ++p;
+    *val = static_cast<float>(parse_float(p, end, &q));
+    if (q == p) {  // empty/non-numeric value
+      *out = skip_token(start, end);
+      return false;
+    }
+    p = q;
+  }
+  if (!at_token_end(p, end)) {  // trailing junk glued to the token
+    *out = skip_token(start, end);
+    return false;
+  }
+  *out = p;
+  return true;
+}
+
 void libsvm_count_chunk(const char* p, const char* end, int idx, void* vctx) {
   auto* ctx = static_cast<LibsvmCounts*>(vctx);
   int64_t rows = 0, nnz = 0;
@@ -133,10 +188,10 @@ void libsvm_count_chunk(const char* p, const char* end, int idx, void* vctx) {
       continue;
     }
     ++rows;
-    // label
+    // label: numeric prefix; junk label parses as 0 and is token-skipped
     const char* q;
     parse_float(p, end, &q);
-    p = q;
+    p = (q == p) ? skip_token(p, end) : q;
     // features
     while (p < end && *p != '\n') {
       p = skip_ws(p, end);
@@ -145,14 +200,10 @@ void libsvm_count_chunk(const char* p, const char* end, int idx, void* vctx) {
         while (p < end && *p != '\n') ++p;
         break;
       }
-      parse_u64(p, end, &q);
+      uint64_t key;
+      float val;
+      if (parse_feature(p, end, &q, &key, &val)) ++nnz;
       p = q;
-      if (p < end && *p == ':') {
-        ++p;
-        parse_float(p, end, &q);
-        p = q;
-      }
-      ++nnz;
     }
     if (p < end) ++p;  // consume '\n'
   }
@@ -182,7 +233,7 @@ void libsvm_fill_chunk(const char* p, const char* end, int idx, void* vctx) {
     }
     const char* q;
     ctx->labels[r] = static_cast<float>(parse_float(p, end, &q));
-    p = q;
+    p = (q == p) ? skip_token(p, end) : q;
     while (p < end && *p != '\n') {
       p = skip_ws(p, end);
       if (p >= end || *p == '\n') break;
@@ -190,17 +241,14 @@ void libsvm_fill_chunk(const char* p, const char* end, int idx, void* vctx) {
         while (p < end && *p != '\n') ++p;
         break;
       }
-      uint64_t key = parse_u64(p, end, &q);
-      p = q;
-      float val = 1.0f;
-      if (p < end && *p == ':') {
-        ++p;
-        val = static_cast<float>(parse_float(p, end, &q));
-        p = q;
+      uint64_t key;
+      float val;
+      if (parse_feature(p, end, &q, &key, &val)) {
+        ctx->indices[k] = key;
+        ctx->values[k] = val;
+        ++k;
       }
-      ctx->indices[k] = key;
-      ctx->values[k] = val;
-      ++k;
+      p = q;
     }
     ctx->indptr[r + 1] = k;
     ++r;
@@ -246,20 +294,23 @@ void criteo_fill_chunk(const char* p, const char* end, int idx, void* vctx) {
     const void* nlv = memchr(p, '\n', end - p);
     const char* eol = nlv ? static_cast<const char*>(nlv) : end;
     if (line_blank(p, eol)) { p = eol + 1; continue; }
-    // label
+    // label: numeric prefix, then field-isolate (junk never desyncs columns)
     const char* q;
     ctx->labels[r] = static_cast<float>(parse_float(p, eol, &q));
-    p = (q < eol && *q == '\t') ? q + 1 : q;
-    // dense ints (may be empty between tabs -> 0, matching criteo missing)
+    p = q;
+    while (p < eol && *p != '\t') ++p;
+    if (p < eol) ++p;
+    // dense ints (may be empty between tabs -> 0, matching criteo missing);
+    // junk after the numeric prefix is skipped so columns never desync
     float* drow = ctx->dense + r * nd;
     for (int i = 0; i < nd; ++i) {
+      drow[i] = 0.0f;
       if (p < eol && *p != '\t') {
         drow[i] = static_cast<float>(parse_float(p, eol, &q));
         p = q;
-      } else {
-        drow[i] = 0.0f;
       }
-      if (p < eol && *p == '\t') ++p;
+      while (p < eol && *p != '\t') ++p;  // field-isolate
+      if (p < eol) ++p;
     }
     // categorical hex fields -> per-slot salted mix64 keys
     uint64_t* krow = ctx->keys + r * nc;
@@ -287,29 +338,38 @@ void criteo_fill_chunk(const char* p, const char* end, int idx, void* vctx) {
 
 extern "C" {
 
-// Count rows/nnz of a libsvm buffer. Outputs per-call totals.
+// Count rows/nnz of a libsvm buffer.  Writes per-chunk counts into the
+// caller-allocated chunk_rows/chunk_nnz (each of size nthreads) so the
+// subsequent ps_libsvm_fill can place chunk output without re-counting —
+// one count pass + one fill pass total.
 void ps_libsvm_count(const char* buf, int64_t len, int nthreads,
-                     int64_t* out_rows, int64_t* out_nnz) {
-  auto off = line_chunks(buf, len, nthreads > 0 ? nthreads : 1);
+                     int64_t* out_rows, int64_t* out_nnz,
+                     int64_t* chunk_rows, int64_t* chunk_nnz) {
+  int nt = nthreads > 0 ? nthreads : 1;
+  auto off = line_chunks(buf, len, nt);
   int n = static_cast<int>(off.size()) - 1;
   LibsvmCounts ctx{std::vector<int64_t>(n, 0), std::vector<int64_t>(n, 0)};
   run_chunks(buf, len, nthreads, off, libsvm_count_chunk, &ctx);
   int64_t rows = 0, nnz = 0;
-  for (int i = 0; i < n; ++i) { rows += ctx.rows[i]; nnz += ctx.nnz[i]; }
+  for (int i = 0; i < n; ++i) {
+    rows += ctx.rows[i];
+    nnz += ctx.nnz[i];
+    if (chunk_rows) chunk_rows[i] = ctx.rows[i];
+    if (chunk_nnz) chunk_nnz[i] = ctx.nnz[i];
+  }
   *out_rows = rows;
   *out_nnz = nnz;
 }
 
-// Fill caller-allocated CSR buffers (sized from ps_libsvm_count).
+// Fill caller-allocated CSR buffers (sized from ps_libsvm_count), with the
+// per-chunk counts that call produced (same buf/len/nthreads required).
 // indptr has rows+1 entries; this writes indptr[1..rows].
 void ps_libsvm_fill(const char* buf, int64_t len, int nthreads,
+                    const int64_t* chunk_rows, const int64_t* chunk_nnz,
                     float* labels, int64_t* indptr, uint64_t* indices,
                     float* values) {
   auto off = line_chunks(buf, len, nthreads > 0 ? nthreads : 1);
   int n = static_cast<int>(off.size()) - 1;
-  // re-count per chunk to place each chunk's output
-  LibsvmCounts counts{std::vector<int64_t>(n, 0), std::vector<int64_t>(n, 0)};
-  run_chunks(buf, len, nthreads, off, libsvm_count_chunk, &counts);
   LibsvmFill ctx;
   ctx.labels = labels;
   ctx.indptr = indptr;
@@ -318,32 +378,34 @@ void ps_libsvm_fill(const char* buf, int64_t len, int nthreads,
   ctx.row_base.assign(n, 0);
   ctx.nnz_base.assign(n, 0);
   for (int i = 1; i < n; ++i) {
-    ctx.row_base[i] = ctx.row_base[i - 1] + counts.rows[i - 1];
-    ctx.nnz_base[i] = ctx.nnz_base[i - 1] + counts.nnz[i - 1];
+    ctx.row_base[i] = ctx.row_base[i - 1] + chunk_rows[i - 1];
+    ctx.nnz_base[i] = ctx.nnz_base[i - 1] + chunk_nnz[i - 1];
   }
   indptr[0] = 0;
   run_chunks(buf, len, nthreads, off, libsvm_fill_chunk, &ctx);
 }
 
 void ps_criteo_count(const char* buf, int64_t len, int nthreads,
-                     int64_t* out_rows) {
+                     int64_t* out_rows, int64_t* chunk_rows) {
   auto off = line_chunks(buf, len, nthreads > 0 ? nthreads : 1);
   int n = static_cast<int>(off.size()) - 1;
   CriteoCtx ctx;
   ctx.rows.assign(n, 0);
   run_chunks(buf, len, nthreads, off, criteo_count_chunk, &ctx);
   int64_t rows = 0;
-  for (int i = 0; i < n; ++i) rows += ctx.rows[i];
+  for (int i = 0; i < n; ++i) {
+    rows += ctx.rows[i];
+    if (chunk_rows) chunk_rows[i] = ctx.rows[i];
+  }
   *out_rows = rows;
 }
 
-void ps_criteo_fill(const char* buf, int64_t len, int nthreads, int n_dense,
-                    int n_cat, float* labels, float* dense, uint64_t* keys) {
+void ps_criteo_fill(const char* buf, int64_t len, int nthreads,
+                    const int64_t* chunk_rows, int n_dense, int n_cat,
+                    float* labels, float* dense, uint64_t* keys) {
   auto off = line_chunks(buf, len, nthreads > 0 ? nthreads : 1);
   int n = static_cast<int>(off.size()) - 1;
   CriteoCtx ctx;
-  ctx.rows.assign(n, 0);
-  run_chunks(buf, len, nthreads, off, criteo_count_chunk, &ctx);
   ctx.labels = labels;
   ctx.dense = dense;
   ctx.keys = keys;
@@ -351,7 +413,7 @@ void ps_criteo_fill(const char* buf, int64_t len, int nthreads, int n_dense,
   ctx.n_cat = n_cat;
   ctx.row_base.assign(n, 0);
   for (int i = 1; i < n; ++i)
-    ctx.row_base[i] = ctx.row_base[i - 1] + ctx.rows[i - 1];
+    ctx.row_base[i] = ctx.row_base[i - 1] + chunk_rows[i - 1];
   run_chunks(buf, len, nthreads, off, criteo_fill_chunk, &ctx);
 }
 

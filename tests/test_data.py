@@ -88,6 +88,50 @@ def test_criteo_native_matches_python_and_hashes():
         np.testing.assert_array_equal(kn, kp)
 
 
+def test_malformed_tokens_skip_not_hang():
+    """qid:/negative/junk-suffix tokens are skipped whole by BOTH parsers.
+
+    Regression: the native tokenizer previously made no forward progress on
+    tokens without a leading digit (infinite loop in count, overrun in fill).
+    """
+    svm = (
+        b"1 qid:3 5:1\n"          # qid token skipped, 5:1 kept
+        b"0 -3:0.5 7:2\n"         # negative key skipped
+        b"1 3:0.5x 9:1\n"         # junk-suffix value: token skipped
+        b"0 5: 11:1\n"            # empty value: token skipped
+        b"1 3.5:1 13:4\n"         # non-integer key skipped
+        b"abc 15:1e2\n"           # junk label -> 0.0, exponent value kept
+    )
+    a = _py_parse(text_lib.parse_libsvm, svm)
+    np.testing.assert_array_equal(a.labels, [1, 0, 1, 0, 1, 0])
+    np.testing.assert_array_equal(a.indices, [5, 7, 9, 11, 13, 15])
+    np.testing.assert_allclose(a.values, [1, 2, 1, 1, 4, 100])
+    if native.load("textparse") is not None:
+        b = text_lib.parse_libsvm(svm)
+        np.testing.assert_array_equal(a.labels, b.labels)
+        np.testing.assert_array_equal(a.indptr, b.indptr)
+        np.testing.assert_array_equal(a.indices, b.indices)
+        np.testing.assert_allclose(a.values, b.values)
+
+
+def test_criteo_dense_junk_no_desync():
+    """Non-numeric dense fields zero that field only; columns stay aligned."""
+    tsv = (
+        b"1\tnan\t2\t1a\t4\t5\t6\t7\t8\t9\t10\t11\t12\t99"
+        + b"\t" + b"\t".join(b"%02x" % i for i in range(26)) + b"\n"
+    )
+    lp, dp, kp = _py_parse(text_lib.parse_criteo, tsv)
+    assert dp[0, 0] == 0.0  # 'nan' rejected (C numeric subset has no nan)
+    assert dp[0, 1] == 2.0
+    assert dp[0, 2] == 1.0  # '1a' -> numeric prefix 1, junk dropped
+    assert dp[0, 12] == 99.0
+    assert kp[0, 0] == text_lib.hash_cat(np.uint64(0), 0)  # col 14 == "00"
+    if native.load("textparse") is not None:
+        ln, dn, kn = text_lib.parse_criteo(tsv)
+        np.testing.assert_array_equal(dn, dp)
+        np.testing.assert_array_equal(kn, kp)
+
+
 def test_parser_parity_edge_cases():
     """Comment lines, blank CRLF lines, junk/overflow hex — both paths agree."""
     svm = b"# header comment\n1 3:0.5\n   # indented comment\n0 5:1\n"
@@ -118,7 +162,7 @@ def test_parser_parity_edge_cases():
 
 
 def test_mix64_abi_parity():
-    lib = native.load("textparse")
+    lib = text_lib._lib()  # sets ps_mix64 argtypes/restype (order-independent)
     if lib is None:
         pytest.skip("no native toolchain")
     xs = np.random.default_rng(1).integers(0, 1 << 63, size=32, dtype=np.uint64)
@@ -168,6 +212,14 @@ def test_slot_reader_caches(tmp_path):
     full2 = r.read_all()
     np.testing.assert_array_equal(full.indices, full2.indices)
     np.testing.assert_array_equal(full.indptr, full2.indptr)
+    # warm-cache fast path: overwrite the raw file with garbage while
+    # preserving (size, mtime) — the manifest + chunk cache must serve the
+    # ORIGINAL data without touching the raw bytes
+    st = data.stat()
+    data.write_bytes(b"#" * st.st_size)
+    os.utime(data, ns=(st.st_atime_ns, st.st_mtime_ns))
+    full3 = r.read_all()
+    np.testing.assert_array_equal(full.indices, full3.indices)
 
 
 def test_stream_reader_batches(tmp_path):
