@@ -1,0 +1,253 @@
+"""Sharded checkpoint / resume for KV tables, optimizer state, and clocks.
+
+Reference analogue: on a ``SaveModel`` task every server writes its key-range
+of the model to file/HDFS and ``model_evaluation`` reads the parts back
+(``src/app/linear_method/model_evaluation.h`` [U]).  The reference saves only
+weights; this module closes the gap called out in SURVEY.md §5 by also saving
+optimizer-state rows and the consistency vector clocks, so training can resume
+mid-stream (SSP window intact) rather than restart.
+
+Layout (one directory per step)::
+
+    <root>/step_000042/
+        MANIFEST.json                     # written LAST -> commit marker
+        w.shard0-of-2.npz                 # value + optimizer state rows
+        w.shard1-of-2.npz
+
+Each shard file holds the server's contiguous row-range (NodeAssigner
+scheme, ``kv/partition.py``) *excluding* the trash row, plus its global row
+offset.  Restore is elastic: the new server count may differ from the saved
+one — each restoring server reads exactly the old shard files overlapping its
+new row-range and slices them (the re-shard path of SURVEY.md §5 elastic
+recovery).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import tempfile
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from parameter_server_tpu.kv.partition import RangePartition
+from parameter_server_tpu.kv.table import KVTable
+
+_STEP_PREFIX = "step_"
+_MANIFEST = "MANIFEST.json"
+
+
+def _step_dir(root: str, step: int) -> str:
+    return os.path.join(root, f"{_STEP_PREFIX}{step:06d}")
+
+
+def _shard_path(step_dir: str, table: str, s: int, n: int) -> str:
+    return os.path.join(step_dir, f"{table}.shard{s}-of-{n}.npz")
+
+
+@dataclasses.dataclass(frozen=True)
+class CheckpointInfo:
+    step: int
+    num_servers: int
+    tables: Dict[str, int]  # table name -> global rows
+    clocks: List[int]
+    extras: Dict[str, Any]
+
+
+def save_shard(
+    root: str,
+    step: int,
+    table_name: str,
+    table: KVTable,
+    server_index: int,
+    num_servers: int,
+    row_offset: int,
+) -> str:
+    """Write one server's row-range of one table (value + optimizer state).
+
+    Safe to call concurrently from all servers: each writes a distinct file
+    via an adjacent temp name + atomic rename.
+    """
+    step_dir = _step_dir(root, step)
+    os.makedirs(step_dir, exist_ok=True)
+    path = _shard_path(step_dir, table_name, server_index, num_servers)
+    arrays = {
+        "value": np.asarray(table.value)[: table.rows],
+        "row_offset": np.asarray(row_offset, dtype=np.int64),
+    }
+    for k, v in table.state.items():
+        arrays[f"state.{k}"] = np.asarray(v)[: table.rows]
+    fd, tmp = tempfile.mkstemp(dir=step_dir, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **arrays)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+    return path
+
+
+def finalize(
+    root: str,
+    step: int,
+    num_servers: int,
+    tables: Dict[str, int],
+    clocks: Optional[List[int]] = None,
+    extras: Optional[Dict[str, Any]] = None,
+) -> None:
+    """Coordinator commit: verify every shard exists, then write MANIFEST.
+
+    A step directory without MANIFEST.json is an aborted save and is ignored
+    by ``latest_step``/``restore`` — the commit-marker pattern.
+    """
+    step_dir = _step_dir(root, step)
+    for t, _rows in tables.items():
+        for s in range(num_servers):
+            p = _shard_path(step_dir, t, s, num_servers)
+            if not os.path.exists(p):
+                raise FileNotFoundError(f"missing shard before commit: {p}")
+    manifest = {
+        "step": step,
+        "num_servers": num_servers,
+        "tables": dict(tables),
+        "clocks": list(clocks or []),
+        "extras": dict(extras or {}),
+    }
+    tmp = os.path.join(step_dir, _MANIFEST + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=1)
+    os.replace(tmp, os.path.join(step_dir, _MANIFEST))
+
+
+def list_steps(root: str) -> List[int]:
+    """Committed checkpoint steps, ascending."""
+    if not os.path.isdir(root):
+        return []
+    steps = []
+    for name in os.listdir(root):
+        if not name.startswith(_STEP_PREFIX):
+            continue
+        if not os.path.exists(os.path.join(root, name, _MANIFEST)):
+            continue  # aborted save
+        try:
+            steps.append(int(name[len(_STEP_PREFIX) :]))
+        except ValueError:
+            continue
+    return sorted(steps)
+
+
+def latest_step(root: str) -> Optional[int]:
+    steps = list_steps(root)
+    return steps[-1] if steps else None
+
+
+def read_info(root: str, step: int) -> CheckpointInfo:
+    with open(os.path.join(_step_dir(root, step), _MANIFEST)) as f:
+        m = json.load(f)
+    return CheckpointInfo(
+        step=m["step"],
+        num_servers=m["num_servers"],
+        tables={k: int(v) for k, v in m["tables"].items()},
+        clocks=[int(c) for c in m["clocks"]],
+        extras=m["extras"],
+    )
+
+
+def _load_range(
+    step_dir: str,
+    table_name: str,
+    saved_partition: RangePartition,
+    lo: int,
+    hi: int,
+) -> Dict[str, np.ndarray]:
+    """Assemble global rows [lo, hi) of a table from the saved shard files.
+
+    Reads only the shards overlapping the range — the elastic-restore core.
+    """
+    off = saved_partition.offsets
+    n = saved_partition.num_servers
+    pieces: Dict[str, List[np.ndarray]] = {}
+    for s in range(n):
+        s_lo, s_hi = int(off[s]), int(off[s + 1])
+        a, b = max(lo, s_lo), min(hi, s_hi)
+        if a >= b:
+            continue
+        with np.load(_shard_path(step_dir, table_name, s, n)) as z:
+            if int(z["row_offset"]) != s_lo:
+                raise ValueError(
+                    f"shard {s} of {table_name}: offset {int(z['row_offset'])}"
+                    f" != expected {s_lo}"
+                )
+            for k in z.files:
+                if k == "row_offset":
+                    continue
+                pieces.setdefault(k, []).append(z[k][a - s_lo : b - s_lo])
+    return {k: np.concatenate(v, axis=0) for k, v in pieces.items()}
+
+
+def restore_shard(
+    root: str,
+    step: int,
+    table_name: str,
+    table: KVTable,
+    server_index: int,
+    num_servers: int,
+) -> None:
+    """Load this server's (possibly re-sharded) row-range into ``table``.
+
+    ``num_servers`` is the NEW server count; the saved count comes from the
+    manifest.  The table's trash row is reset to init fills.
+    """
+    info = read_info(root, step)
+    rows = info.tables[table_name]
+    saved = RangePartition(rows, info.num_servers)
+    new = RangePartition(rows, num_servers)
+    off = new.offsets
+    lo, hi = int(off[server_index]), int(off[server_index + 1])
+    if hi - lo != table.rows:
+        raise ValueError(
+            f"table shard rows {table.rows} != partition range {hi - lo}"
+        )
+    arrays = _load_range(_step_dir(root, step), table_name, saved, lo, hi)
+    import jax.numpy as jnp
+
+    fills = table.optimizer.state_shapes()
+    value = np.zeros((table.rows + 1, table.dim), np.asarray(table.value).dtype)
+    value[: table.rows] = arrays["value"]
+    table.value = jnp.asarray(value)
+    for k in table.state:
+        buf = np.full(
+            (table.rows + 1, table.dim),
+            fills[k],
+            np.asarray(table.state[k]).dtype,
+        )
+        buf[: table.rows] = arrays[f"state.{k}"]
+        table.state[k] = jnp.asarray(buf)
+
+
+def load_global_weights(root: str, step: int, table_name: str) -> np.ndarray:
+    """Full servable weight table for offline eval (model_evaluation path).
+
+    Note: returns the raw *value* rows; for lazy-weight optimizers (FTRL) use
+    ``load_global_arrays`` and compute weights via the optimizer.
+    """
+    return load_global_arrays(root, step, table_name)["value"]
+
+
+def load_global_arrays(root: str, step: int, table_name: str) -> Dict[str, np.ndarray]:
+    info = read_info(root, step)
+    rows = info.tables[table_name]
+    saved = RangePartition(rows, info.num_servers)
+    return _load_range(_step_dir(root, step), table_name, saved, 0, rows)
+
+
+def retain(root: str, keep: int) -> None:
+    """Delete all but the newest ``keep`` committed checkpoints."""
+    import shutil
+
+    for step in list_steps(root)[:-keep] if keep > 0 else []:
+        shutil.rmtree(_step_dir(root, step), ignore_errors=True)

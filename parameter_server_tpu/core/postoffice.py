@@ -13,6 +13,8 @@ dispatch time via :class:`~parameter_server_tpu.core.clock.ConsistencyController
 
 from __future__ import annotations
 
+import dataclasses
+import logging
 import threading
 from typing import Callable, Optional
 
@@ -43,7 +45,23 @@ class Postoffice:
         if customer is None:
             return  # unknown customer: drop (matches reference glog-and-drop)
         if msg.is_request:
-            reply = customer.process_request(msg)
+            try:
+                reply = customer.process_request(msg)
+            except Exception as e:  # noqa: BLE001
+                # A failed handler must still answer: otherwise the
+                # requester's wait(ts) hangs forever on the missing leg.  The
+                # error rides back in the reply payload (Customer records it;
+                # see Customer.errors) and the endpoint thread stays alive.
+                logging.getLogger(__name__).exception(
+                    "%s: handler error for %s from %s",
+                    self.node_id,
+                    msg.task.kind,
+                    msg.sender,
+                )
+                reply = msg.reply()
+                reply.task = dataclasses.replace(
+                    msg.task, payload={"__error__": f"{type(e).__name__}: {e}"}
+                )
             if reply is not None:
                 self.van.send(reply)
         else:
@@ -66,6 +84,7 @@ class Customer:
         self._pending: dict[int, int] = {}
         self._callbacks: dict[int, Callable[[list[Message]], None]] = {}
         self._responses: dict[int, list[Message]] = {}
+        self._errors: dict[int, list[str]] = {}
         self._kept: set[int] = set()  # timestamps whose responses are retained
         self._executed: dict[str, int] = {}  # per-sender executed task time
         self._cond = threading.Condition()
@@ -131,18 +150,33 @@ class Customer:
         """Drain (and forget) the responses of a ``keep_responses`` task."""
         with self._cond:
             self._kept.discard(ts)
+            self._errors.pop(ts, None)
             return self._responses.pop(ts, [])
 
     def _on_response(self, msg: Message) -> None:
         ts = msg.task.time
+        err = msg.task.payload.get("__error__")
         with self._cond:
             if ts not in self._pending:
                 return  # late/duplicate response
+            if err is not None:
+                self._errors.setdefault(ts, []).append(f"{msg.sender}: {err}")
             if ts in self._responses:
                 self._responses[ts].append(msg)
             self._pending[ts] -= 1
             if self._pending[ts] <= 0:
                 self._finish_locked(ts)
+
+    def errors(self, ts: int) -> list[str]:
+        """Remote handler errors reported in task ``ts``'s responses."""
+        with self._cond:
+            return list(self._errors.get(ts, []))
+
+    def check(self, ts: int) -> None:
+        """Raise if any receiver answered task ``ts`` with an error."""
+        errs = self.errors(ts)
+        if errs:
+            raise RuntimeError(f"task {ts} failed on: " + "; ".join(errs))
 
     def _finish_locked(self, ts: int) -> None:
         del self._pending[ts]
@@ -151,6 +185,9 @@ class Customer:
             responses = self._responses.get(ts, [])
         else:
             responses = self._responses.pop(ts, [])
+            # error strings are only retained for kept tasks (the callers
+            # that inspect them); fire-and-forget errors were already logged
+            self._errors.pop(ts, None)
         self._cond.notify_all()
         if cb is not None:
             # Fire outside the lock to allow callbacks to re-submit.

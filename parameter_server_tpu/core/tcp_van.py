@@ -24,6 +24,7 @@ Design notes:
 from __future__ import annotations
 
 import ctypes
+import logging
 import pickle
 import socket
 import struct
@@ -34,7 +35,7 @@ import numpy as np
 
 from parameter_server_tpu import native
 from parameter_server_tpu.core.messages import Message, Task, TaskKind
-from parameter_server_tpu.core.van import Van
+from parameter_server_tpu.core.van import Van, _Endpoint
 
 _u8p = ctypes.POINTER(ctypes.c_uint8)
 
@@ -93,8 +94,10 @@ def serialize_message(msg: Message) -> bytes:
         },
         protocol=pickle.HIGHEST_PROTOCOL,
     )
+    # single copy: join reads the arrays' buffers directly (no tobytes()
+    # intermediates) — the SArray zero-copy role on the send side
     parts = [struct.pack("<I", len(header)), header]
-    parts += [a.tobytes() for a in arrays]
+    parts += [memoryview(a).cast("B") for a in arrays]
     return b"".join(parts)
 
 
@@ -161,7 +164,10 @@ class TcpVan(Van):
         self.port = actual.value
         self.advertise_host = advertise_host or "127.0.0.1"
         self.filter_chain = filter_chain
-        self._handlers: Dict[str, Callable[[Message], None]] = {}
+        #: bound local nodes: per-node inbox + single handler thread, exactly
+        #: like LoopbackVan — KVServer table mutation relies on each node's
+        #: handler being single-threaded by construction.
+        self._endpoints: Dict[str, _Endpoint] = {}
         self._routes: Dict[str, Tuple[str, int]] = {}
         self._conns: Dict[Tuple[str, int], int] = {}
         self._link_locks: Dict[tuple, threading.Lock] = {}
@@ -190,19 +196,24 @@ class TcpVan(Van):
 
     def bind(self, node_id: str, handler: Callable[[Message], None]) -> None:
         with self._lock:
-            if node_id in self._handlers:
+            if node_id in self._endpoints:
                 raise ValueError(f"node {node_id!r} already bound")
-            self._handlers[node_id] = handler
+            self._endpoints[node_id] = _Endpoint(node_id, handler)
 
     # -- send ----------------------------------------------------------------
     def send(self, msg: Message) -> bool:
+        if self._closed.is_set():
+            with self._lock:
+                self.dropped_messages += 1
+            return False
         with self._lock:
-            local = self._handlers.get(msg.recver)
+            local = self._endpoints.get(msg.recver)
         if local is not None:
-            # same-process fast path: no serialization, match LoopbackVan
+            # same-process fast path: no serialization; the endpoint's own
+            # thread runs the handler (single-threaded per node)
             with self._lock:
                 self.sent_messages += 1
-            local(msg)
+            local.inbox.put(msg)
             return True
         with self._lock:
             addr = self._routes.get(msg.recver)
@@ -211,13 +222,24 @@ class TcpVan(Van):
                 self.dropped_messages += 1
             return False
         if self.filter_chain is not None:
+            # Stateful filters (key caching) need wire-FIFO per link: hold the
+            # link lock across encode AND the socket write so a later encode
+            # cannot overtake an earlier frame onto the wire (LoopbackVan
+            # documents the same invariant).
             with self._lock:
                 ll = self._link_locks.setdefault(
                     (msg.sender, msg.recver), threading.Lock()
                 )
             with ll:
                 msg = self.filter_chain.encode(msg)
-        data = serialize_message(msg)
+                return self._send_wire(serialize_message(msg), addr)
+        return self._send_wire(serialize_message(msg), addr)
+
+    def _send_wire(self, data: bytes, addr: Tuple[str, int]) -> bool:
+        if self._closed.is_set() or self._van is None:
+            with self._lock:
+                self.dropped_messages += 1
+            return False
         conn = self._get_conn(addr)
         if conn is None:
             with self._lock:
@@ -231,7 +253,11 @@ class TcpVan(Van):
                 self.sent_messages += 1
             else:
                 self.dropped_messages += 1
-                self._conns.pop(addr, None)  # force reconnect next time
+                # force reconnect next time; release the native fd + thread
+                if self._conns.get(addr) == conn:
+                    self._conns.pop(addr, None)
+        if rc != 0:
+            self._lib.ps_van_disconnect(self._van, conn)
         return rc == 0
 
     def _get_conn(self, addr: Tuple[str, int]) -> Optional[int]:
@@ -249,6 +275,9 @@ class TcpVan(Van):
         with self._lock:
             # lost race: keep the first connection
             existing = self._conns.setdefault(addr, conn)
+        if existing != conn:
+            # release the abandoned duplicate (fd + native recv thread)
+            self._lib.ps_van_disconnect(self._van, conn)
         return existing
 
     # -- receive -------------------------------------------------------------
@@ -273,24 +302,37 @@ class TcpVan(Van):
                 msg = deserialize_message(memoryview(raw))
             except Exception:
                 continue  # corrupt frame: drop (wire-level noise tolerance)
-            if self.filter_chain is not None:
+            try:
+                if self.filter_chain is not None:
+                    with self._lock:
+                        ll = self._link_locks.setdefault(
+                            (msg.sender, msg.recver), threading.Lock()
+                        )
+                    with ll:
+                        msg = self.filter_chain.decode(msg)
+            except Exception:  # noqa: BLE001 — one bad message must not kill
+                # the single dispatch thread (that would silently disable all
+                # reception for every node in this process)
+                logging.getLogger(__name__).exception(
+                    "tcpvan: dropping message for %r after filter-decode error",
+                    msg.recver,
+                )
                 with self._lock:
-                    ll = self._link_locks.setdefault(
-                        (msg.sender, msg.recver), threading.Lock()
-                    )
-                with ll:
-                    msg = self.filter_chain.decode(msg)
+                    self.dropped_messages += 1
+                continue
             with self._lock:
-                handler = self._handlers.get(msg.recver)
-            if handler is not None:
-                handler(msg)
+                ep = self._endpoints.get(msg.recver)
+            if ep is not None:
+                ep.inbox.put(msg)  # handler runs on the endpoint's own thread
 
     # -- stats / lifecycle ---------------------------------------------------
     def bytes_sent(self) -> int:
-        return int(self._lib.ps_van_bytes_sent(self._van))
+        van = self._van
+        return int(self._lib.ps_van_bytes_sent(van)) if van else 0
 
     def bytes_recv(self) -> int:
-        return int(self._lib.ps_van_bytes_recv(self._van))
+        van = self._van
+        return int(self._lib.ps_van_bytes_recv(van)) if van else 0
 
     def close(self) -> None:
         if self._closed.is_set():
@@ -298,6 +340,18 @@ class TcpVan(Van):
         # dispatch thread exits on its next timeout tick BEFORE the native
         # handle is destroyed (it dereferences the handle in ps_van_recv)
         self._closed.set()
-        self._dispatch.join(timeout=5)
+        self._dispatch.join(timeout=30)
+        with self._lock:
+            endpoints = list(self._endpoints.values())
+        for ep in endpoints:
+            ep.stop()
+        if self._dispatch.is_alive():
+            # The dispatch thread is wedged (>30s).  Freeing the native van
+            # now would be a use-after-free in that thread; leak the handle
+            # instead — the process is tearing down anyway.
+            logging.getLogger(__name__).error(
+                "tcpvan: dispatch thread did not exit; leaking native handle"
+            )
+            return
         self._lib.ps_van_close(self._van)
         self._van = None

@@ -20,6 +20,7 @@ tests — something the reference never had (SURVEY.md §4 "opportunity").
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 from typing import Callable, Optional
@@ -58,7 +59,15 @@ class _Endpoint:
             msg = self.inbox.get()
             if msg is None:
                 return
-            self.handler(msg)
+            try:
+                self.handler(msg)
+            except Exception:  # noqa: BLE001 — a bad message must not kill
+                # the node's only receive thread (all later messages for the
+                # node would silently queue forever)
+                logging.getLogger(__name__).exception(
+                    "van: handler error on node %r; message dropped",
+                    self.node_id,
+                )
 
     def stop(self) -> None:
         self.inbox.put(None)

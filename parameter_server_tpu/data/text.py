@@ -175,22 +175,31 @@ def _float_prefix(tok: bytes) -> tuple[float, int]:
             i += 1
         ex = 0
         while i < n and 48 <= tok[i] <= 57:
-            ex = ex * 10 + (tok[i] - 48)
+            if ex < 10000:  # saturate like the native parser
+                ex = ex * 10 + (tok[i] - 48)
             i += 1
-        v *= 10.0 ** (-ex if eneg else ex)
+        try:
+            v *= 10.0 ** (-ex if eneg else ex)
+        except OverflowError:  # C pow() returns inf here; match it
+            v = float("inf") if v else 0.0
     return (-v if neg else v), i
 
 
 def _parse_libsvm_py(data: bytes) -> CSRBatch:
     labels, indptr, indices, values = [], [0], [], []
     for line in data.split(b"\n"):
-        line = line.split(b"#", 1)[0].strip()
-        if not line:
+        line = line.strip()
+        # '#' is a comment ONLY at token start (native rule): a full-line
+        # comment skips the row; '#' glued inside a token makes that token
+        # malformed (skipped whole below), NOT a line truncation.
+        if not line or line.startswith(b"#"):
             continue
         parts = line.split()
         label, _ = _float_prefix(parts[0])  # junk label -> 0.0, row kept
         labels.append(label)
         for tok in parts[1:]:
+            if tok.startswith(b"#"):
+                break  # trailing comment: rest of line ignored
             # accept/skip rules identical to the native parse_feature():
             # key must be all digits; value (if present) must be a fully-
             # consumed numeric; malformed tokens are skipped whole.

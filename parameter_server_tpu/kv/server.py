@@ -53,6 +53,8 @@ class KVServer(Customer):
         self.pulls = 0
 
     def handle_request(self, msg: Message) -> Message:
+        if msg.task.kind == TaskKind.CONTROL:
+            return self._handle_control(msg)
         table = self.tables[msg.task.payload["table"]]
         ids = jnp.asarray(msg.keys)
         if msg.task.kind == TaskKind.PUSH:
@@ -64,3 +66,40 @@ class KVServer(Customer):
             self.pulls += 1
             return msg.reply(values=[np.asarray(rows)])
         raise ValueError(f"unsupported task kind {msg.task.kind}")
+
+    # -- checkpoint (reference SaveModel task: servers write their key-range
+    # to file; src/app/linear_method/model_evaluation.h [U]) -----------------
+    def _handle_control(self, msg: Message) -> Message:
+        op = msg.task.payload.get("op")
+        if op == "save_model":
+            self.save_checkpoint(msg.task.payload["root"], msg.task.payload["step"])
+            return msg.reply()
+        if op == "load_model":
+            self.restore_checkpoint(msg.task.payload["root"], msg.task.payload["step"])
+            return msg.reply()
+        raise ValueError(f"unsupported control op {op!r}")
+
+    def save_checkpoint(self, root: str, step: int) -> None:
+        """Write this server's row-range of every table (value + opt state)."""
+        from parameter_server_tpu import checkpoint
+
+        for t, table in self.tables.items():
+            part = self.partitions[t]
+            checkpoint.save_shard(
+                root,
+                step,
+                t,
+                table,
+                self.server_index,
+                part.num_servers,
+                int(part.offsets[self.server_index]),
+            )
+
+    def restore_checkpoint(self, root: str, step: int) -> None:
+        """Load this server's row-range; the saved server count may differ."""
+        from parameter_server_tpu import checkpoint
+
+        for t, table in self.tables.items():
+            checkpoint.restore_shard(
+                root, step, t, table, self.server_index, self.partitions[t].num_servers
+            )

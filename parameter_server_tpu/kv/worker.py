@@ -142,3 +142,58 @@ class KVWorker(Customer):
     ) -> np.ndarray:
         return self.pull_result(self.pull(table, keys), timeout)
 
+    # -- checkpoint (reference SaveModel/LoadModel broadcast tasks) ----------
+    def save_model(
+        self,
+        root: str,
+        step: int,
+        *,
+        clocks: Optional[list] = None,
+        extras: Optional[dict] = None,
+        timeout: Optional[float] = 600.0,
+    ) -> None:
+        """Broadcast SaveModel to all servers, then commit the manifest.
+
+        Blocking: returns once every shard is on disk and MANIFEST.json is
+        written (the commit marker — see ``checkpoint.finalize``).  Raises if
+        any server's save failed (disk full etc.) instead of committing a
+        partial checkpoint.
+        """
+        from parameter_server_tpu import checkpoint
+
+        ts = self._broadcast_control("save_model", {"root": root, "step": step})
+        if not self.wait(ts, timeout):
+            raise TimeoutError("save_model timed out")
+        self.check(ts)
+        self.take_responses(ts)
+        checkpoint.finalize(
+            root,
+            step,
+            self.num_servers,
+            {t: cfg.rows for t, cfg in self.table_cfgs.items()},
+            clocks=clocks,
+            extras=extras,
+        )
+
+    def load_model(
+        self, root: str, step: int, *, timeout: Optional[float] = 600.0
+    ) -> None:
+        """Broadcast LoadModel: every server restores its row-range."""
+        ts = self._broadcast_control("load_model", {"root": root, "step": step})
+        if not self.wait(ts, timeout):
+            raise TimeoutError("load_model timed out")
+        self.check(ts)
+        self.take_responses(ts)
+
+    def _broadcast_control(self, op: str, payload: dict) -> int:
+        msgs = [
+            Message(
+                task=Task(
+                    TaskKind.CONTROL, self.name, payload={"op": op, **payload}
+                ),
+                recver=server_id(s),
+            )
+            for s in range(self.num_servers)
+        ]
+        return self.submit(msgs, keep_responses=True)
+

@@ -103,10 +103,11 @@ void recv_loop(VanImpl* van, Conn* conn) {
     van->bytes_recv += static_cast<int64_t>(len) + 12;
     {
       std::unique_lock<std::mutex> lk(van->q_mu);
-      van->q_cv.wait(lk, [van] {
-        return van->queue.size() < van->max_queue || !van->running.load();
+      van->q_cv.wait(lk, [van, conn] {
+        return van->queue.size() < van->max_queue || !van->running.load() ||
+               !conn->open.load();
       });
-      if (!van->running.load()) break;
+      if (!van->running.load() || !conn->open.load()) break;
       van->queue.push_back(std::move(f));
     }
     van->q_cv.notify_all();
@@ -130,8 +131,16 @@ Conn* add_conn(VanImpl* van, int fd) {
   conn->id = van->next_conn++;
   conn->open.store(true);
   Conn* raw = conn.get();
-  raw->recv_thread = std::thread(recv_loop, van, raw);
+  // Everything (including the thread start) happens under conns_mu so
+  // ps_van_close can never observe a half-constructed entry, and a conn
+  // accepted concurrently with close() gets shut down here instead of
+  // being missed by close()'s shutdown sweep (which may already have run).
   std::lock_guard<std::mutex> lk(van->conns_mu);
+  if (!van->running.load()) {
+    raw->open.store(false);
+    ::shutdown(fd, SHUT_RDWR);
+  }
+  raw->recv_thread = std::thread(recv_loop, van, raw);
   van->conns.push_back(std::move(conn));
   return raw;
 }
@@ -248,11 +257,25 @@ int64_t ps_van_recv(void* vvan, double timeout_s, uint8_t** out_data,
 
 void ps_van_free(uint8_t* buf) { free(buf); }
 
-// Close one connection (fault injection / peer removal).
+// Close one connection (fault injection / peer removal / failed-send reap).
+// Fully reclaims the fd and recv thread; the Conn object itself stays in
+// `conns` as a tombstone so raw pointers held by concurrent ps_van_send
+// calls remain valid (send fails via open == false).
 void ps_van_disconnect(void* vvan, int conn_id) {
   auto* van = static_cast<VanImpl*>(vvan);
-  Conn* conn = get_conn(van, conn_id);
-  if (conn && conn->open.exchange(false)) ::shutdown(conn->fd, SHUT_RDWR);
+  std::lock_guard<std::mutex> reap_lk(van->conns_mu);
+  Conn* conn = nullptr;
+  for (auto& c : van->conns)
+    if (c->id == conn_id) { conn = c.get(); break; }
+  if (!conn) return;
+  if (conn->open.exchange(false)) ::shutdown(conn->fd, SHUT_RDWR);
+  van->q_cv.notify_all();  // wake its recv thread if parked on backpressure
+  if (conn->recv_thread.joinable()) conn->recv_thread.join();
+  std::lock_guard<std::mutex> send_lk(conn->send_mu);  // no in-flight writer
+  if (conn->fd >= 0) {
+    ::close(conn->fd);
+    conn->fd = -1;
+  }
 }
 
 int64_t ps_van_bytes_sent(void* vvan) {
@@ -279,7 +302,7 @@ void ps_van_close(void* vvan) {
     std::lock_guard<std::mutex> lk(van->conns_mu);
     for (auto& c : van->conns) {
       if (c->recv_thread.joinable()) c->recv_thread.join();
-      ::close(c->fd);
+      if (c->fd >= 0) ::close(c->fd);  // -1 = already reaped by disconnect
     }
   }
   delete van;

@@ -77,8 +77,13 @@ inline double parse_float(const char* p, const char* end, const char** out) {
     bool eneg = false;
     if (p < end && (*p == '+' || *p == '-')) eneg = (*p++ == '-');
     int ex = 0;
-    while (p < end && *p >= '0' && *p <= '9') ex = ex * 10 + (*p++ - '0');
-    v *= std::pow(10.0, eneg ? -ex : ex);
+    // saturate: any exponent > 9999 already over/underflows double, and an
+    // unchecked accumulator is signed-int-overflow UB on 10+ digit exponents
+    while (p < end && *p >= '0' && *p <= '9') {
+      if (ex < 10000) ex = ex * 10 + (*p - '0');
+      ++p;
+    }
+    if (v != 0.0) v *= std::pow(10.0, eneg ? -ex : ex);  // avoid 0*inf = nan
   }
   *out = p;
   return neg ? -v : v;

@@ -277,3 +277,41 @@ def test_e2e_train_from_libsvm_file(tmp_path):
     for keys, _vals, labels in sr:
         losses.append(tr.step(keys, labels))
     assert np.mean(losses[-5:]) < np.mean(losses[:5]) - 0.05
+
+
+def test_libsvm_hash_comment_parity():
+    """'#' glued inside a token is a malformed token, not a line truncation.
+
+    Regression: the Python fallback used to cut the line at the first '#'
+    anywhere, diverging from the native rule (comment only at token start;
+    mid-token '#' -> skip that token whole, keep parsing the line).
+    """
+    data = (
+        b"1 3:1#x 5:2\n"      # 3:1#x malformed -> only 5:2 survives
+        b"# full line comment\n"
+        b"0 7:1 # trailing 9:9\n"  # comment token ends the line
+        b"1 12#4:5 8:1\n"     # 12#4:5 malformed key -> only 8:1
+    )
+    b = _py_parse(text_lib.parse_libsvm, data)
+    np.testing.assert_array_equal(b.labels, [1, 0, 1])
+    np.testing.assert_array_equal(b.indices, [5, 7, 8])
+    np.testing.assert_array_equal(b.indptr, [0, 1, 2, 3])
+    if native.load("textparse") is not None:
+        a = text_lib.parse_libsvm(data)
+        np.testing.assert_array_equal(a.labels, b.labels)
+        np.testing.assert_array_equal(a.indices, b.indices)
+        np.testing.assert_array_equal(a.indptr, b.indptr)
+        np.testing.assert_allclose(a.values, b.values)
+
+
+def test_float_exponent_overflow_parity():
+    """Huge exponents must saturate to inf/0, never raise or wrap (UB)."""
+    data = b"1 3:1e400 4:1e-400 5:2e2147483648 6:1.5\n"
+    b = _py_parse(text_lib.parse_libsvm, data)
+    np.testing.assert_array_equal(b.indices, [3, 4, 5, 6])
+    assert np.isinf(b.values[0]) and b.values[1] == 0.0
+    assert np.isinf(b.values[2]) and b.values[3] == pytest.approx(1.5)
+    if native.load("textparse") is not None:
+        a = text_lib.parse_libsvm(data)
+        np.testing.assert_array_equal(a.indices, b.indices)
+        np.testing.assert_array_equal(a.values, b.values)
