@@ -126,7 +126,21 @@ class Customer:
         if undeliverable:
             # Dead receiver(s): complete their legs immediately so wait()
             # cannot hang; the learner layer re-assigns work (WorkloadPool).
+            # The drop is recorded as an error so callers that inspect
+            # responses (pulls, checkpoints) can distinguish "acked" from
+            # "silently dropped" instead of reading zeros.
+            logging.getLogger(__name__).warning(
+                "%s/%s: task %s undeliverable to %s (dropped)",
+                self.post.node_id,
+                self.name,
+                ts,
+                [m.recver for m in undeliverable],
+            )
             with self._cond:
+                for m in undeliverable:
+                    self._errors.setdefault(ts, []).append(
+                        f"{m.recver}: undeliverable"
+                    )
                 self._pending[ts] -= len(undeliverable)
                 if self._pending[ts] <= 0:
                     self._finish_locked(ts)

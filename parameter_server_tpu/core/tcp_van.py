@@ -200,6 +200,13 @@ class TcpVan(Van):
                 raise ValueError(f"node {node_id!r} already bound")
             self._endpoints[node_id] = _Endpoint(node_id, handler)
 
+    def unbind(self, node_id: str) -> None:
+        """Tear down a node's endpoint (see LoopbackVan.unbind)."""
+        with self._lock:
+            ep = self._endpoints.pop(node_id, None)
+        if ep is not None:
+            ep.stop()
+
     # -- send ----------------------------------------------------------------
     def send(self, msg: Message) -> bool:
         if self._closed.is_set():

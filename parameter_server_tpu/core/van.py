@@ -38,6 +38,11 @@ class Van:
         """Deliver ``msg`` to ``msg.recver``.  Returns False if unreachable."""
         raise NotImplementedError
 
+    def unbind(self, node_id: str) -> None:
+        """Tear down a bound node's endpoint so a replacement can bind the
+        same id (elastic server recovery relies on this)."""
+        raise NotImplementedError
+
     def close(self) -> None:
         pass
 
@@ -136,6 +141,15 @@ class LoopbackVan(Van):
     def reconnect(self, node_id: str) -> None:
         with self._lock:
             self._disconnected.discard(node_id)
+
+    def unbind(self, node_id: str) -> None:
+        """Tear down a node's endpoint so a replacement can bind the same id
+        (elastic recovery: a rebuilt server shard takes over its dead
+        predecessor's identity and key range)."""
+        with self._lock:
+            ep = self._endpoints.pop(node_id, None)
+        if ep is not None:
+            ep.stop()
 
     def close(self) -> None:
         with self._lock:

@@ -124,12 +124,22 @@ class KVWorker(Customer):
         Output shape: ``keys.shape + (dim,)`` for dim>1 tables, ``keys.shape``
         for dim=1.
         """
-        if not self.wait(ts, timeout):
+        completed = self.wait(ts, timeout)
+        plan = self._pull_plans.pop(ts)  # always reclaim, even on error paths
+        errs = self.errors(ts)
+        responses = self.take_responses(ts)  # always drain kept state
+        if not completed:
             raise TimeoutError(f"pull ts={ts} timed out")
-        plan = self._pull_plans.pop(ts)
+        if errs:  # a dropped leg must not read as zero weights
+            raise RuntimeError(f"pull ts={ts} failed on: " + "; ".join(errs))
+        if len(responses) < len(plan["order"]):
+            raise RuntimeError(
+                f"pull ts={ts} incomplete: {len(responses)}/"
+                f"{len(plan['order'])} servers answered (dead server?)"
+            )
         cfg = self.table_cfgs[plan["table"]]
         uniq_rows = np.zeros((plan["n_slots"], cfg.dim), dtype=cfg.dtype)
-        for resp in self.take_responses(ts):
+        for resp in responses:
             seg = plan["order"][resp.sender]
             uniq_rows[seg] = resp.values[0]
         out = uniq_rows[plan["inverse"]]

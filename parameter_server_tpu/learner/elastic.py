@@ -1,0 +1,274 @@
+"""ElasticTrainer: fault-tolerant PS training — the full recovery loop.
+
+Glues the pieces SURVEY.md §5 lists for failure handling into one driver,
+mirroring the reference's composition (heartbeats -> Manager REMOVE_NODE ->
+``Executor::ReplaceNode`` re-slice + WorkloadPool re-assignment [U]):
+
+- :class:`~parameter_server_tpu.core.manager.Manager` heartbeat monitoring
+  detects silent nodes and fires ``on_node_dead``;
+- a dead **worker**'s unfinished workloads return to the
+  :class:`~parameter_server_tpu.learner.workload.WorkloadPool` and surviving
+  workers drain them; the
+  :class:`~parameter_server_tpu.core.clock.ConsistencyController` excludes the
+  dead worker from the SSP bound so the window never wedges;
+- a dead **server** means lost shard state: recovery restores the shard from
+  the latest committed checkpoint (``checkpoint.restore_shard``), which the
+  trainer writes every ``ckpt_every`` completed workloads.  The reference
+  paper's chain replication was at best partial in the open tree; snapshot
+  restore is the survey's chosen equivalent.
+
+The trainer is Van-agnostic: fault injection in tests uses
+``LoopbackVan.disconnect`` (a dead socket) + a forced heartbeat sweep, and the
+same code paths fire on a real DCN Van when a host drops.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from parameter_server_tpu.config import ConsistencyConfig
+from parameter_server_tpu.core.clock import ConsistencyController
+from parameter_server_tpu.core.manager import Manager
+from parameter_server_tpu.kv.worker import KVWorker
+from parameter_server_tpu.learner.workload import WorkloadPool
+from parameter_server_tpu.models import linear
+from parameter_server_tpu.utils.threads import run_threads
+
+log = logging.getLogger(__name__)
+
+#: one workload payload: list of (keys, labels) minibatches
+Shard = List[Tuple[np.ndarray, np.ndarray]]
+
+
+class ElasticTrainer:
+    """Pool-driven sparse-LR training that survives node loss.
+
+    Unlike :class:`~parameter_server_tpu.learner.sgd.AsyncLRLearner` (fixed
+    steps per worker), workers here draw *workloads* (data shards) from the
+    shared pool, so work lost to a death is re-drawn by survivors — the
+    reference's SGD scaffold + WorkloadPool composition [U].
+    """
+
+    def __init__(
+        self,
+        workers: Dict[str, KVWorker],
+        scheduler: Manager,
+        shards: List[Shard],
+        consistency: ConsistencyConfig,
+        *,
+        table: str = "w",
+        managers: Optional[Dict[str, Manager]] = None,
+        heartbeat_interval: float = 0.5,
+        ckpt_root: Optional[str] = None,
+        ckpt_every: int = 0,
+        timeout: float = 60.0,
+    ) -> None:
+        self.workers = workers
+        self.scheduler = scheduler
+        #: per-worker Manager instances for liveness reporting; without them
+        #: the scheduler's heartbeat sweep would mark every worker dead.
+        self.managers = managers or {}
+        self.heartbeat_interval = heartbeat_interval
+        self.table = table
+        self.pool = WorkloadPool(shards)
+        self.controller = ConsistencyController(consistency, len(workers))
+        self._index = {wid: i for i, wid in enumerate(sorted(workers))}
+        self.ckpt_root = ckpt_root
+        self.ckpt_every = ckpt_every
+        self.timeout = timeout
+        self._ckpt_lock = threading.Lock()
+        self._ckpt_pending = 0
+        self._ckpt_running = False
+        self.last_ckpt_step: Optional[int] = None
+        self.losses: List[float] = []
+        self._loss_lock = threading.Lock()
+        self._killed: set[str] = set()
+        # membership -> pool/clock wiring (Executor::ReplaceNode analogue)
+        scheduler.on_node_dead.append(self._on_dead)
+        scheduler.on_node_added.append(self._on_added)
+
+    def kill(self, wid: str) -> None:
+        """Fault injection: make worker ``wid`` stop executing (SURVEY.md §5
+        kill-a-process hook).  The caller also disconnects its Van endpoint;
+        the heartbeat sweep then detects the death and requeues its work."""
+        self._killed.add(wid)
+
+    # -- elasticity callbacks (scheduler thread) -----------------------------
+    def _on_dead(self, node_id: str) -> None:
+        requeued = self.pool.mark_dead(node_id)
+        idx = self._index.get(node_id)
+        if idx is not None:
+            self.controller.mark_dead(idx)
+        if requeued:
+            log.warning("node %s dead: requeued workloads %s", node_id, requeued)
+
+    def _on_added(self, node_id: str) -> None:
+        self.pool.mark_alive(node_id)
+        idx = self._index.get(node_id)
+        if idx is not None:
+            self.controller.mark_alive(idx)
+
+    # -- training ------------------------------------------------------------
+    def run(self, *, poll: float = 0.02) -> List[float]:
+        """Drain the pool with all workers; returns recorded losses.
+
+        Individual worker failures (Van timeouts after a kill) are swallowed
+        — the scheduler's failure detection re-queues their work; only a
+        wholly-failed run (work left but no live workers) raises.
+        """
+        hb_stop = threading.Event()
+        hb_thread = None
+        if self.managers:
+            hb_thread = threading.Thread(
+                target=self._heartbeat_loop,
+                args=(hb_stop,),
+                name="elastic-heartbeat",
+                daemon=True,
+            )
+            hb_thread.start()
+        try:
+            run_threads(
+                [
+                    (lambda wid=wid, kv=kv: self._worker_loop(wid, kv, poll))
+                    for wid, kv in self.workers.items()
+                ],
+                name="elastic-worker",
+            )
+        finally:
+            hb_stop.set()
+            if hb_thread is not None:
+                hb_thread.join(timeout=5)
+        if not self.pool.all_done():
+            raise RuntimeError(
+                f"workloads incomplete: {self.pool.num_done()}/{len(self.pool)}"
+            )
+        return list(self.losses)
+
+    def _heartbeat_loop(self, stop: threading.Event) -> None:
+        """Background liveness reporting for every managed node.
+
+        A dedicated thread (the reference runs heartbeats off the worker
+        compute thread too [U]) so a long device step / jit compile never
+        reads as a death.  Killed nodes stop heartbeating — that IS the
+        death signal the scheduler sweep detects.
+        """
+        from parameter_server_tpu.core.messages import SCHEDULER
+
+        while not stop.wait(self.heartbeat_interval):
+            for nid, mgr in self.managers.items():
+                if nid == SCHEDULER or nid in self._killed:
+                    continue
+                mgr.send_heartbeat()
+
+    def _worker_loop(self, wid: str, kv: KVWorker, poll: float) -> None:
+        idx = self._index[wid]
+        iteration = 0
+        try:
+            self._worker_loop_inner(wid, kv, idx, iteration, poll)
+        finally:
+            # Retire from the staleness bound on ANY exit (drained, died,
+            # stalled): a stopped clock must not wedge survivors' SSP window.
+            self.controller.mark_dead(idx)
+
+    def _worker_loop_inner(
+        self, wid: str, kv: KVWorker, idx: int, iteration: int, poll: float
+    ) -> None:
+        while True:
+            if wid in self._killed:
+                return  # the "process" is gone; no further sends, no finish
+            wl = self.pool.get(wid)
+            if wl is None:
+                if self.pool.all_done() or not self.scheduler.is_alive(wid):
+                    return
+                time.sleep(poll)  # pool empty but stragglers outstanding
+                continue
+            try:
+                for keys, labels in wl.payload:
+                    if wid in self._killed:
+                        return
+                    if not self.controller.wait_turn(
+                        idx, iteration, timeout=self.timeout
+                    ):
+                        raise TimeoutError(f"{wid} stalled (SSP bound)")
+                    w_pos = kv.pull_sync(self.table, keys, timeout=self.timeout)
+                    g, _gb, loss = linear.grad_rows(
+                        jnp.asarray(w_pos), jnp.asarray(labels)
+                    )
+                    ts = kv.push(
+                        self.table, keys, np.asarray(g) / labels.shape[0]
+                    )
+                    if not kv.wait(ts, timeout=self.timeout):
+                        raise TimeoutError(f"{wid} push never acked")
+                    self.controller.finish_iteration(idx)
+                    iteration += 1
+                    with self._loss_lock:
+                        self.losses.append(float(loss))
+            except (TimeoutError, RuntimeError) as e:
+                # This worker is partitioned/dead from the cluster's view
+                # (pull timeout, undeliverable sends, or a dead-server leg) —
+                # its thread exits (the "process" dies); the heartbeat sweep
+                # requeues the workload for survivors.
+                log.warning("worker %s failed (%s); exiting loop", wid, e)
+                return
+            if self.pool.finish(wid, wl.workload_id):
+                self._maybe_checkpoint(kv)
+
+    def _maybe_checkpoint(self, kv: KVWorker) -> None:
+        if not self.ckpt_root or self.ckpt_every <= 0:
+            return
+        # decide under the lock; run the (blocking) save OUTSIDE it so other
+        # workers finishing workloads never queue behind checkpoint IO
+        with self._ckpt_lock:
+            self._ckpt_pending += 1
+            if self._ckpt_pending < self.ckpt_every or self._ckpt_running:
+                return
+            self._ckpt_pending = 0
+            self._ckpt_running = True
+        step = self.pool.num_done()
+        try:
+            kv.save_model(
+                self.ckpt_root,
+                step,
+                clocks=self.controller.clock.snapshot(),
+                timeout=self.timeout,
+            )
+            self.last_ckpt_step = step
+        except (TimeoutError, RuntimeError) as e:
+            # checkpoint failure must not kill training (a dead server
+            # mid-save is exactly the scenario recovery handles)
+            log.warning("checkpoint at %s failed: %s", step, e)
+        finally:
+            with self._ckpt_lock:
+                self._ckpt_running = False
+
+
+def recover_server(
+    make_server: Callable[[], object],
+    ckpt_root: str,
+    *,
+    step: Optional[int] = None,
+) -> object:
+    """Rebuild a lost server shard from the latest committed checkpoint.
+
+    ``make_server`` constructs the replacement
+    :class:`~parameter_server_tpu.kv.server.KVServer` (fresh tables, same
+    shard index) bound to a live Van endpoint; its shard rows are then
+    restored in place.  Returns the new server.  Raises ``FileNotFoundError``
+    when no committed checkpoint exists — the caller decides whether a cold
+    restart is acceptable.
+    """
+    from parameter_server_tpu import checkpoint
+
+    if step is None:
+        step = checkpoint.latest_step(ckpt_root)
+    if step is None:
+        raise FileNotFoundError(f"no committed checkpoint under {ckpt_root}")
+    server = make_server()
+    server.restore_checkpoint(ckpt_root, step)
+    return server
