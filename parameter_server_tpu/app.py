@@ -1,0 +1,268 @@
+"""App factory: registry + config-file driven app construction.
+
+Reference analogue: ``src/system/app.h/.cc`` — ``App::Create(conf)`` reads the
+text-proto config, looks up the app class by its config type, and the
+scheduler calls ``app->Run()`` (SURVEY.md §2 #7 [U — reference mount empty,
+public layout]).  Here the registry is keyed by a string ``app:`` field in a
+yaml/json config file, apps are callables returning a result dict, and the
+same config vocabulary (data / optimizer / penalty / consistency) carries
+over via the dataclasses in ``config.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Callable, Dict, Optional
+
+from parameter_server_tpu.config import (
+    ConsistencyConfig,
+    ConsistencyMode,
+    OptimizerConfig,
+    TableConfig,
+    TopologyConfig,
+)
+
+
+@dataclasses.dataclass
+class DataConfig:
+    """Input source: synthetic CTR stream or an on-disk text dataset."""
+
+    kind: str = "synthetic"  # synthetic | libsvm | criteo
+    path: Optional[str] = None
+    batch_size: int = 1024
+    #: synthetic stream parameters (ignored for file inputs)
+    key_space: int = 1 << 22
+    nnz: int = 39
+    seed: int = 0
+
+
+@dataclasses.dataclass
+class AppConfig:
+    """One training/eval job — the reference's app-level text proto."""
+
+    app: str
+    table: TableConfig
+    data: DataConfig = dataclasses.field(default_factory=DataConfig)
+    consistency: ConsistencyConfig = dataclasses.field(
+        default_factory=ConsistencyConfig
+    )
+    topology: TopologyConfig = dataclasses.field(default_factory=TopologyConfig)
+    steps: int = 100
+    eval_batches: int = 0
+    ckpt_root: Optional[str] = None
+    ckpt_every: int = 0
+
+
+_REGISTRY: Dict[str, Callable[[AppConfig], Callable[[], dict]]] = {}
+
+
+def register_app(name: str):
+    """Decorator: register an app builder under ``name``.
+
+    A builder takes the :class:`AppConfig` and returns a zero-arg ``run``
+    callable producing a result dict (losses, metrics, ...).
+    """
+
+    def deco(builder):
+        if name in _REGISTRY:
+            raise ValueError(f"app {name!r} already registered")
+        _REGISTRY[name] = builder
+        return builder
+
+    return deco
+
+
+def registered_apps() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+def create(cfg: AppConfig) -> Callable[[], dict]:
+    """The ``App::Create`` seam: config -> runnable app."""
+    try:
+        builder = _REGISTRY[cfg.app]
+    except KeyError:
+        raise ValueError(
+            f"unknown app {cfg.app!r}; registered: {registered_apps()}"
+        ) from None
+    return builder(cfg)
+
+
+# --------------------------------------------------------------- config IO --
+
+
+def _hydrate(cls, obj: Any):
+    """Recursively build a dataclass from a plain dict (yaml/json)."""
+    if obj is None or not dataclasses.is_dataclass(cls):
+        return obj
+    if not isinstance(obj, dict):
+        raise TypeError(f"expected mapping for {cls.__name__}, got {type(obj)}")
+    kwargs = {}
+    fields = {f.name: f for f in dataclasses.fields(cls)}
+    for k, v in obj.items():
+        if k not in fields:
+            raise ValueError(f"unknown field {k!r} for {cls.__name__}")
+        ftype = fields[k].type
+        target = _FIELD_TYPES.get((cls.__name__, k))
+        if target is not None:
+            v = _hydrate(target, v) if isinstance(v, dict) else target(v)
+        kwargs[k] = v
+        del ftype
+    return cls(**kwargs)
+
+
+#: nested dataclass/enum fields (dataclass field types are strings under
+#: ``from __future__ import annotations``, so map them explicitly)
+_FIELD_TYPES = {
+    ("AppConfig", "table"): TableConfig,
+    ("AppConfig", "data"): DataConfig,
+    ("AppConfig", "consistency"): ConsistencyConfig,
+    ("AppConfig", "topology"): TopologyConfig,
+    ("TableConfig", "optimizer"): OptimizerConfig,
+    ("ConsistencyConfig", "mode"): ConsistencyMode,
+}
+
+
+def load_config(path: str) -> AppConfig:
+    """Read a yaml/json app config file into an :class:`AppConfig`."""
+    with open(path) as f:
+        text = f.read()
+    if path.endswith(".json"):
+        raw = json.loads(text)
+    else:
+        import yaml
+
+        raw = yaml.safe_load(text)
+    if not isinstance(raw, dict) or "app" not in raw:
+        raise ValueError(f"{path}: config must be a mapping with an 'app' key")
+    return _hydrate(AppConfig, raw)
+
+
+# ------------------------------------------------------------ built-in apps --
+
+
+def _make_batch_fn(data: DataConfig):
+    if data.kind == "synthetic":
+        from parameter_server_tpu.data.synthetic import SyntheticCTR
+
+        stream = SyntheticCTR(
+            key_space=data.key_space,
+            nnz=data.nnz,
+            batch_size=data.batch_size,
+            seed=data.seed,
+        )
+        return stream.next_batch
+    if data.kind in ("libsvm", "criteo"):
+        from parameter_server_tpu.data.reader import StreamReader
+
+        if not data.path:
+            raise ValueError(f"data.kind={data.kind!r} requires data.path")
+        reader = StreamReader(
+            [data.path], data.batch_size, format=data.kind, epochs=None
+        )
+        it = iter(reader)
+
+        def next_batch():
+            keys, _vals, labels = next(it)
+            return keys, labels
+
+        return next_batch
+    raise ValueError(f"unknown data kind {data.kind!r}")
+
+
+@register_app("sparse_lr")
+def _build_sparse_lr(cfg: AppConfig) -> Callable[[], dict]:
+    """Single-device fused sparse LR (BASELINE config #1 shape)."""
+    from parameter_server_tpu.learner.sgd import LocalLRTrainer
+
+    def run() -> dict:
+        trainer = LocalLRTrainer(cfg.table)
+        batch_fn = _make_batch_fn(cfg.data)
+        losses = [trainer.step(*batch_fn()) for _ in range(cfg.steps)]
+        out = {"losses": losses, "steps": cfg.steps}
+        if cfg.eval_batches:
+            out["auc"] = trainer.eval_auc(batch_fn, cfg.eval_batches)
+        return out
+
+    return run
+
+
+@register_app("fm")
+def _build_fm(cfg: AppConfig) -> Callable[[], dict]:
+    """Single-device fused factorization machine (table dim = 1 + k)."""
+    from parameter_server_tpu.learner.fm import LocalFMTrainer
+
+    def run() -> dict:
+        trainer = LocalFMTrainer(cfg.table)
+        batch_fn = _make_batch_fn(cfg.data)
+        losses = [trainer.step(*batch_fn()) for _ in range(cfg.steps)]
+        out = {"losses": losses, "steps": cfg.steps}
+        if cfg.eval_batches:
+            out["auc"] = trainer.eval_auc(batch_fn, cfg.eval_batches)
+        return out
+
+    return run
+
+
+@register_app("async_lr")
+def _build_async_lr(cfg: AppConfig) -> Callable[[], dict]:
+    """Classic PS topology on one host: scheduler + servers + worker threads
+    over the LoopbackVan with BSP/SSP/ASP gating and elastic workloads."""
+
+    def run() -> dict:
+        import numpy as np
+
+        from parameter_server_tpu.core.manager import launch_local_cluster
+        from parameter_server_tpu.core.messages import server_id, worker_id
+        from parameter_server_tpu.core.van import LoopbackVan
+        from parameter_server_tpu.kv.server import KVServer
+        from parameter_server_tpu.kv.worker import KVWorker
+        from parameter_server_tpu.learner.elastic import ElasticTrainer
+        from parameter_server_tpu.utils.keys import HashLocalizer
+
+        nw, ns = cfg.topology.num_workers, cfg.topology.num_servers
+        van = LoopbackVan()
+        try:
+            sched, managers, posts = launch_local_cluster(
+                van, num_workers=nw, num_servers=ns
+            )
+            tables = {cfg.table.name: cfg.table}
+            loc = {cfg.table.name: HashLocalizer(cfg.table.rows)}
+            _servers = {
+                server_id(i): KVServer(posts[server_id(i)], tables, i, ns)
+                for i in range(ns)
+            }
+            workers = {
+                worker_id(i): KVWorker(
+                    posts[worker_id(i)], tables, ns, localizers=loc
+                )
+                for i in range(nw)
+            }
+            batch_fn = _make_batch_fn(cfg.data)
+            batches_per_shard = 4
+            n_shards = max(1, cfg.steps // batches_per_shard)
+            shards = [
+                [batch_fn() for _ in range(batches_per_shard)]
+                for _ in range(n_shards)
+            ]
+            trainer = ElasticTrainer(
+                workers,
+                sched,
+                shards,
+                cfg.consistency,
+                managers=managers,
+                table=cfg.table.name,
+                ckpt_root=cfg.ckpt_root,
+                ckpt_every=cfg.ckpt_every,
+            )
+            losses = trainer.run()
+            return {
+                "losses": losses,
+                "steps": len(losses),
+                "mean_loss_tail": float(np.mean(losses[-10:])),
+                "last_ckpt_step": trainer.last_ckpt_step,
+            }
+        finally:
+            van.close()
+
+    return run

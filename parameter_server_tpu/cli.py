@@ -1,0 +1,106 @@
+"""psx — the command-line launcher.
+
+Reference analogue: ``script/local.sh`` + the gflags/`main.cc` entry point
+(SURVEY.md §2 #23 [U]): one binary, behavior selected by config.  Here::
+
+    psx run config.yaml [--steps N]     # run a registered app from a config
+    psx eval CKPT_ROOT --table w ...    # offline AUC from a checkpoint
+    psx apps                            # list registered apps
+
+Installed as a console script (``pyproject.toml``) and runnable as
+``python -m parameter_server_tpu.cli``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+
+import numpy as np
+
+
+def _cmd_run(args: argparse.Namespace) -> int:
+    from parameter_server_tpu import app as app_lib
+
+    cfg = app_lib.load_config(args.config)
+    if args.steps is not None:
+        cfg = dataclasses.replace(cfg, steps=args.steps)
+    run = app_lib.create(cfg)
+    result = run()
+    losses = result.pop("losses", [])
+    if losses:
+        result["first_loss"] = round(float(np.mean(losses[:10])), 6)
+        result["final_loss"] = round(float(np.mean(losses[-10:])), 6)
+    print(json.dumps({"app": cfg.app, **result}))
+    return 0
+
+
+def _cmd_eval(args: argparse.Namespace) -> int:
+    from parameter_server_tpu import evaluation
+    from parameter_server_tpu.utils.keys import HashLocalizer
+
+    from parameter_server_tpu.data.synthetic import SyntheticCTR
+
+    stream = SyntheticCTR(
+        key_space=args.key_space,
+        nnz=args.nnz,
+        batch_size=args.batch_size,
+        seed=args.seed,
+    )
+    batches = [stream.next_batch() for _ in range(args.batches)]
+    report = evaluation.evaluate_checkpoint(
+        args.ckpt_root,
+        args.table,
+        batches,
+        step=args.step,
+        model=args.model,
+        localizer=HashLocalizer(args.rows) if args.rows else None,
+    )
+    print(json.dumps(report))
+    return 0
+
+
+def _cmd_apps(_args: argparse.Namespace) -> int:
+    from parameter_server_tpu import app as app_lib
+
+    for name in app_lib.registered_apps():
+        print(name)
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="psx", description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    run = sub.add_parser("run", help="run an app from a yaml/json config")
+    run.add_argument("config")
+    run.add_argument("--steps", type=int, default=None, help="override steps")
+    run.set_defaults(fn=_cmd_run)
+
+    ev = sub.add_parser("eval", help="offline eval of a saved checkpoint")
+    ev.add_argument("ckpt_root")
+    ev.add_argument("--table", default="w")
+    ev.add_argument("--model", default="lr", choices=["lr", "fm"])
+    ev.add_argument("--step", type=int, default=None)
+    ev.add_argument("--rows", type=int, default=0, help="localizer capacity")
+    ev.add_argument("--batches", type=int, default=8)
+    ev.add_argument("--batch-size", type=int, default=1024)
+    ev.add_argument("--key-space", type=int, default=1 << 22)
+    ev.add_argument("--nnz", type=int, default=39)
+    ev.add_argument("--seed", type=int, default=0)
+    ev.set_defaults(fn=_cmd_eval)
+
+    apps = sub.add_parser("apps", help="list registered apps")
+    apps.set_defaults(fn=_cmd_apps)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -330,6 +330,7 @@ class Manager(Customer):
 
     def start_monitor(self, interval: float = 1.0) -> None:
         """Scheduler: poll heartbeats in a daemon thread."""
+        self._stop.clear()  # allow start after a previous stop_monitor
 
         def loop() -> None:
             while not self._stop.wait(interval):
@@ -344,6 +345,7 @@ class Manager(Customer):
         self._stop.set()
         if self._monitor_thread is not None:
             self._monitor_thread.join(timeout=5)
+            self._monitor_thread = None
 
 
 def launch_local_cluster(

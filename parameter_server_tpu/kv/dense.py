@@ -135,12 +135,22 @@ class DenseKVWorker(Customer):
         return ts
 
     def pull_result(self, ts: int, timeout: Optional[float] = None) -> np.ndarray:
-        if not self.wait(ts, timeout):
+        completed = self.wait(ts, timeout)
+        table = self._pull_meta.pop(ts)  # always reclaim
+        errs = self.errors(ts)
+        responses = self.take_responses(ts)  # always drain kept state
+        if not completed:
             raise TimeoutError(f"dense pull ts={ts} timed out")
-        table = self._pull_meta.pop(ts)
+        if errs:  # a dropped leg must not read as zero parameters
+            raise RuntimeError(f"dense pull ts={ts} failed on: " + "; ".join(errs))
+        if len(responses) < self.num_servers:
+            raise RuntimeError(
+                f"dense pull ts={ts} incomplete: {len(responses)}/"
+                f"{self.num_servers} servers answered (dead server?)"
+            )
         off = self.offsets[table]
         out = np.zeros(off[-1], np.float32)
-        for resp in self.take_responses(ts):
+        for resp in responses:
             s = int(resp.sender[1:])
             out[off[s] : off[s + 1]] = resp.values[0]
         return out

@@ -124,6 +124,7 @@ class ElasticTrainer:
         """
         hb_stop = threading.Event()
         hb_thread = None
+        started_monitor = False
         if self.managers:
             hb_thread = threading.Thread(
                 target=self._heartbeat_loop,
@@ -132,6 +133,14 @@ class ElasticTrainer:
                 daemon=True,
             )
             hb_thread.start()
+            # the detection side: run the scheduler's sweep unless the
+            # caller already started one (tests may drive it manually too —
+            # extra sweeps are idempotent)
+            if self.scheduler._monitor_thread is None:
+                self.scheduler.start_monitor(
+                    interval=max(self.heartbeat_interval, 0.05)
+                )
+                started_monitor = True
         try:
             run_threads(
                 [
@@ -144,6 +153,8 @@ class ElasticTrainer:
             hb_stop.set()
             if hb_thread is not None:
                 hb_thread.join(timeout=5)
+            if started_monitor:
+                self.scheduler.stop_monitor()
         if not self.pool.all_done():
             raise RuntimeError(
                 f"workloads incomplete: {self.pool.num_done()}/{len(self.pool)}"
@@ -212,9 +223,11 @@ class ElasticTrainer:
             except (TimeoutError, RuntimeError) as e:
                 # This worker is partitioned/dead from the cluster's view
                 # (pull timeout, undeliverable sends, or a dead-server leg) —
-                # its thread exits (the "process" dies); the heartbeat sweep
-                # requeues the workload for survivors.
+                # its thread exits (the "process" dies).  Joining _killed
+                # stops its heartbeats so the scheduler sweep actually
+                # detects the death and requeues the workload for survivors.
                 log.warning("worker %s failed (%s); exiting loop", wid, e)
+                self._killed.add(wid)
                 return
             if self.pool.finish(wid, wl.workload_id):
                 self._maybe_checkpoint(kv)

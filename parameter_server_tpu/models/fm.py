@@ -1,0 +1,125 @@
+"""Factorization machine over the KV layer.
+
+Reference analogue: ``src/app/factorization_machine/`` — the FM model served
+from KV tables (SURVEY.md §2 #17 [U — reference mount empty, public layout]).
+One table holds, per feature row, the linear weight AND the factor vector:
+``dim = 1 + k`` (column 0 = w_i, columns 1..k = v_i), so a single Push/Pull
+moves the whole per-feature parameter block — the reference's KV-layer usage,
+and on TPU one gather instead of two.
+
+With one-hot categorical inputs (x_i = 1 at the example's keys) the
+second-order FM term reduces to
+
+    1/2 * sum_f [ (sum_i v_if)^2 - sum_i v_if^2 ]
+
+and the per-position gradients are dl/dw_i = r and
+dl/dv_if = r * (S_f - v_if) with S_f = sum_j v_jf, r = dloss/dlogit.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from parameter_server_tpu.kv.optim import ServerOptimizer
+from parameter_server_tpu.models.linear import logloss
+from parameter_server_tpu.ops import scatter
+
+
+def fm_logits(rows_pos: jax.Array, bias: jax.Array) -> jax.Array:
+    """Per-example logits from per-position parameter rows ``[B, nnz, 1+k]``."""
+    w_pos = rows_pos[..., 0]  # [B, nnz]
+    v_pos = rows_pos[..., 1:]  # [B, nnz, k]
+    s = jnp.sum(v_pos, axis=1)  # [B, k]
+    pair = 0.5 * jnp.sum(s * s - jnp.sum(v_pos * v_pos, axis=1), axis=-1)
+    return jnp.sum(w_pos, axis=-1) + pair + bias
+
+
+def fm_grad_rows(
+    rows_pos: jax.Array, labels: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Van-path worker compute: per-position gradient rows ``[B, nnz, 1+k]``.
+
+    Returns ``(g_pos, bias_grad, loss)``; gradients are mean-loss scaled so
+    the server applies them unmodified (matches ``linear.grad_rows`` usage).
+    """
+    batch = labels.shape[0]
+    logits = fm_logits(rows_pos, 0.0)
+    loss = logloss(logits, labels)
+    r = (jax.nn.sigmoid(logits) - labels) / batch  # [B]
+    v_pos = rows_pos[..., 1:]
+    s = jnp.sum(v_pos, axis=1, keepdims=True)  # [B, 1, k]
+    g_w = jnp.broadcast_to(r[:, None], rows_pos.shape[:2])[..., None]  # [B,nnz,1]
+    g_v = r[:, None, None] * (s - v_pos)  # [B, nnz, k]
+    return jnp.concatenate([g_w, g_v], axis=-1), jnp.sum(r), loss
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("optimizer", "num_rows"),
+    donate_argnums=(0, 1, 2, 3),
+)
+def fused_train_step(
+    value: jax.Array,
+    state: Dict[str, jax.Array],
+    bias: jax.Array,
+    bias_state: Dict[str, jax.Array],
+    ids: jax.Array,
+    inverse: jax.Array,
+    labels: jax.Array,
+    optimizer: ServerOptimizer,
+    num_rows: int,
+):
+    """One full FM step on the device-resident ``[rows+1, 1+k]`` table.
+
+    Same structure as ``linear.fused_train_step`` (gather touched rows ->
+    loss/grad -> duplicate pre-combine -> optimizer apply -> scatter back,
+    one XLA program, donated buffers); only the model math differs.
+    """
+    batch = labels.shape[0]
+    dim = value.shape[1]
+    rows = optimizer.pull_weights(
+        scatter.gather_rows(value, ids),
+        {k: scatter.gather_rows(v, ids) for k, v in state.items()},
+    )  # [num_rows, 1+k]
+    rows_pos = rows[inverse].reshape(batch, -1, dim)
+    bias_w = optimizer.pull_weights(bias, bias_state)
+    logits = fm_logits(rows_pos, bias_w[0, 0])
+    loss = logloss(logits, labels)
+    r = (jax.nn.sigmoid(logits) - labels) / batch
+    v_pos = rows_pos[..., 1:]
+    s = jnp.sum(v_pos, axis=1, keepdims=True)
+    g_w = jnp.broadcast_to(r[:, None], rows_pos.shape[:2])[..., None]
+    g_v = r[:, None, None] * (s - v_pos)
+    g_pos = jnp.concatenate([g_w, g_v], axis=-1).reshape(-1, dim)
+    combined = scatter.segment_combine(g_pos, inverse, num_rows)
+    v_rows = scatter.gather_rows(value, ids)
+    s_rows = {k: scatter.gather_rows(v, ids) for k, v in state.items()}
+    new_v, new_s = optimizer.apply(v_rows, s_rows, combined)
+    value = scatter.scatter_update_rows_xla(value, ids, new_v)
+    state = {k: scatter.scatter_update_rows_xla(state[k], ids, new_s[k]) for k in state}
+    fills = optimizer.state_shapes()
+    value = value.at[-1].set(0.0)
+    state = {k: state[k].at[-1].set(fills[k]) for k in state}
+    g_bias = jnp.sum(r)[None, None]
+    new_b, new_bs = optimizer.apply(bias, bias_state, g_bias)
+    return value, state, new_b, new_bs, loss
+
+
+def eval_logits_np(table_rows, bias, slots_pos):
+    """Offline scoring from a host-side weight table (model evaluation path).
+
+    ``table_rows``: full ``[rows, 1+k]`` numpy array (e.g. from
+    ``checkpoint.load_global_weights``); ``slots_pos``: ``[B, nnz]`` row ids.
+    """
+    import numpy as np
+
+    rows_pos = table_rows[slots_pos]  # [B, nnz, 1+k]
+    w_pos = rows_pos[..., 0]
+    v_pos = rows_pos[..., 1:]
+    s = np.sum(v_pos, axis=1)
+    pair = 0.5 * np.sum(s * s - np.sum(v_pos * v_pos, axis=1), axis=-1)
+    return np.sum(w_pos, axis=-1) + pair + bias

@@ -166,9 +166,12 @@ def test_server_death_recovery_from_snapshot(tmp_path):
         np.testing.assert_allclose(
             after[on_s1], full[slots[on_s1], 0], rtol=1e-6
         )
+        # training continues against the recovered server: the push must
+        # observably change the weights (a dropped push would leave them)
         ts = w0.push("w", probe, np.ones((100, 1), np.float32))
         assert w0.wait(ts, timeout=10)
-        assert not w0.errors(ts)
+        after_push = w0.pull_sync("w", probe, timeout=10)
+        assert np.abs(after_push - after).max() > 1e-4
     finally:
         van.close()
 
@@ -191,5 +194,27 @@ def test_dead_server_pull_raises_not_zeros():
         van.disconnect(server_id(0))
         with pytest.raises((RuntimeError, TimeoutError)):
             worker.pull_sync("w", keys, timeout=2)
+    finally:
+        van.close()
+
+
+def test_dense_dead_server_pull_raises_not_zeros():
+    """Dense pulls get the same dead-server semantics as sparse pulls."""
+    from parameter_server_tpu.kv.dense import DenseKVServer, DenseKVWorker
+
+    van = LoopbackVan()
+    try:
+        opt = OptimizerConfig(kind="sgd", learning_rate=1.0)
+        servers = [
+            DenseKVServer(
+                Postoffice(server_id(i), van), {"m": (100, opt)}, i, 2
+            )
+            for i in range(2)
+        ]
+        worker = DenseKVWorker(Postoffice("W0", van), {"m": 100}, 2)
+        assert worker.pull_sync("m", timeout=10).shape == (100,)
+        van.disconnect(server_id(1))
+        with pytest.raises((RuntimeError, TimeoutError)):
+            worker.pull_sync("m", timeout=2)
     finally:
         van.close()
