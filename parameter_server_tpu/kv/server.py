@@ -21,6 +21,7 @@ from parameter_server_tpu.core.messages import Message, TaskKind
 from parameter_server_tpu.core.postoffice import Customer, Postoffice
 from parameter_server_tpu.kv.partition import RangePartition
 from parameter_server_tpu.kv.table import KVTable
+from parameter_server_tpu.utils.trace import NULL_TRACER, Tracer
 
 
 class KVServer(Customer):
@@ -34,6 +35,7 @@ class KVServer(Customer):
         num_servers: int,
         *,
         name: str = "kv",
+        tracer: Tracer = NULL_TRACER,
     ) -> None:
         super().__init__(name, post)
         self.server_index = server_index
@@ -51,18 +53,22 @@ class KVServer(Customer):
         #: dashboard counters
         self.pushes = 0
         self.pulls = 0
+        self.tracer = tracer
 
     def handle_request(self, msg: Message) -> Message:
         if msg.task.kind == TaskKind.CONTROL:
             return self._handle_control(msg)
-        table = self.tables[msg.task.payload["table"]]
+        tname = msg.task.payload["table"]
+        table = self.tables[tname]
         ids = jnp.asarray(msg.keys)
         if msg.task.kind == TaskKind.PUSH:
-            table.push(ids, jnp.asarray(msg.values[0]))
+            with self.tracer.span("kv.server.push", table=tname):
+                table.push(ids, jnp.asarray(msg.values[0]))
             self.pushes += 1
             return msg.reply()
         elif msg.task.kind == TaskKind.PULL:
-            rows = table.pull(ids)
+            with self.tracer.span("kv.server.pull", table=tname):
+                rows = table.pull(ids)
             self.pulls += 1
             return msg.reply(values=[np.asarray(rows)])
         raise ValueError(f"unsupported task kind {msg.task.kind}")

@@ -32,6 +32,7 @@ from parameter_server_tpu.core.postoffice import Customer, Postoffice
 from parameter_server_tpu.kv.partition import RangePartition
 from parameter_server_tpu.ops import scatter
 from parameter_server_tpu.utils.keys import HashLocalizer, localize_to_slots
+from parameter_server_tpu.utils.trace import NULL_TRACER, Tracer
 
 
 @functools.partial(jax.jit, static_argnames=("num_rows",))
@@ -49,8 +50,11 @@ class KVWorker(Customer):
         name: str = "kv",
         localizers: Optional[Dict[str, HashLocalizer]] = None,
         min_bucket: int = 256,
+        tracer: Tracer = NULL_TRACER,
     ) -> None:
         super().__init__(name, post)
+        #: host-side span recorder (Push/Pull latency histograms, SURVEY §5)
+        self.tracer = tracer
         self.table_cfgs = table_cfgs
         self.num_servers = num_servers
         self.min_bucket = min_bucket
@@ -70,26 +74,31 @@ class KVWorker(Customer):
         ``values`` has shape ``[len(keys), dim]`` (or ``[len(keys)]`` for
         dim=1 tables).
         """
-        cfg = self.table_cfgs[table]
-        vals = np.asarray(values, dtype=cfg.dtype).reshape(keys.size, cfg.dim)
-        slots, inverse, _n = localize_to_slots(
-            keys, self.localizers[table], min_bucket=self.min_bucket
-        )
-        # device-side duplicate pre-combine (worker-side pre-reduction)
-        combined = np.asarray(
-            _segment_combine(jnp.asarray(inverse), jnp.asarray(vals), slots.shape[0])
-        )
-        msgs = []
-        for s, seg, local in self.partitions[table].slice_ids(slots):
-            msgs.append(
-                Message(
-                    task=Task(TaskKind.PUSH, self.name, payload={"table": table}),
-                    recver=server_id(s),
-                    keys=local,
-                    values=[combined[seg]],
+        with self.tracer.span("kv.push", table=table, n=int(keys.size)):
+            cfg = self.table_cfgs[table]
+            vals = np.asarray(values, dtype=cfg.dtype).reshape(keys.size, cfg.dim)
+            slots, inverse, _n = localize_to_slots(
+                keys, self.localizers[table], min_bucket=self.min_bucket
+            )
+            # device-side duplicate pre-combine (worker-side pre-reduction)
+            combined = np.asarray(
+                _segment_combine(
+                    jnp.asarray(inverse), jnp.asarray(vals), slots.shape[0]
                 )
             )
-        return self.submit(msgs)
+            msgs = []
+            for s, seg, local in self.partitions[table].slice_ids(slots):
+                msgs.append(
+                    Message(
+                        task=Task(
+                            TaskKind.PUSH, self.name, payload={"table": table}
+                        ),
+                        recver=server_id(s),
+                        keys=local,
+                        values=[combined[seg]],
+                    )
+                )
+            return self.submit(msgs)
 
     # -- pull ---------------------------------------------------------------
     def pull(self, table: str, keys: np.ndarray) -> int:
@@ -124,7 +133,8 @@ class KVWorker(Customer):
         Output shape: ``keys.shape + (dim,)`` for dim>1 tables, ``keys.shape``
         for dim=1.
         """
-        completed = self.wait(ts, timeout)
+        with self.tracer.span("kv.pull.wait", ts=ts):
+            completed = self.wait(ts, timeout)
         plan = self._pull_plans.pop(ts)  # always reclaim, even on error paths
         errs = self.errors(ts)
         responses = self.take_responses(ts)  # always drain kept state

@@ -53,21 +53,30 @@ class LocalLRTrainer:
         min_bucket: int = 1024,
         dashboard: Optional[metrics_lib.Dashboard] = None,
         mode: str = "rows",
+        device_hash: bool = False,
     ) -> None:
         """``mode="rows"``: bucketed-unique gather/apply/scatter (general).
         ``mode="dense"``: per-position hashed slots + full-table apply — no
-        host dedup; requires l1 == l2 == 0 and a g=0-stable optimizer."""
+        host dedup; requires l1 == l2 == 0 and a g=0-stable optimizer.
+        ``device_hash``: hash keys ON DEVICE (32-bit; dense mode) — raw
+        uint32 keys ship to the chip and :meth:`step_block` runs K steps per
+        dispatch (for hosts/tunnels where the transfer is the bottleneck)."""
         if table_cfg.dim != 1:
             raise ValueError("LR weight table must have dim=1")
         if mode not in ("rows", "dense"):
             raise ValueError(f"unknown mode {mode!r}")
         if mode == "dense":
             require_dense_apply(table_cfg.optimizer)
+        if device_hash and mode != "dense":
+            raise ValueError("device_hash requires mode='dense'")
         self.mode = mode
+        self.device_hash = device_hash
         self.cfg = table_cfg
         self.table = KVTable(table_cfg)
         self.optimizer = self.table.optimizer
-        self.localizer = HashLocalizer(table_cfg.rows)
+        self.localizer = HashLocalizer(
+            table_cfg.rows, hash_bits=32 if device_hash else 64
+        )
         self.min_bucket = min_bucket
         self.bias = jnp.zeros((1, 1), dtype=jnp.float32)
         self.bias_state = {
@@ -145,6 +154,38 @@ class LocalLRTrainer:
         )
         self.step_count += 1
         return loss
+
+    def step_block(
+        self, keys_block: np.ndarray, labels_block: np.ndarray
+    ) -> jax.Array:
+        """K dense steps in one dispatch (requires ``device_hash``).
+
+        ``keys_block``: ``[K, B, nnz]`` keys (must fit uint32);
+        ``labels_block``: ``[K, B]``.  Returns the device losses ``[K]``
+        without host sync — the block analogue of :meth:`step_async`.
+        """
+        if not self.device_hash:
+            raise ValueError("step_block requires device_hash=True")
+        t = self.table
+        (
+            t.value,
+            t.state,
+            self.bias,
+            self.bias_state,
+            losses,
+        ) = linear.dense_scan_train_step(
+            t.value,
+            t.state,
+            self.bias,
+            self.bias_state,
+            jnp.asarray(np.asarray(keys_block).astype(np.uint32)),
+            jnp.asarray(labels_block),
+            self.optimizer,
+            self.cfg.rows,
+            self.localizer.seed,
+        )
+        self.step_count += int(keys_block.shape[0])
+        return losses
 
     def train(self, batch_fn: BatchFn, num_steps: int) -> None:
         for _ in range(num_steps):

@@ -167,6 +167,71 @@ dense_fused_train_step = functools.partial(
 )(dense_fused_impl)
 
 
+def mix32_jax(x: jax.Array, seed: int = 0) -> jax.Array:
+    """murmur3 fmix32 on device (uint32) — twin of ``utils.keys.mix32``.
+
+    TPUs have no native uint64, so device-side hashing uses the 32-bit
+    avalanche; ``HashLocalizer(hash_bits=32)`` reproduces it on the host.
+    The constants are shared with the host twin so they cannot diverge.
+    """
+    from parameter_server_tpu.utils.keys import MIX32_A, MIX32_B
+
+    x = x.astype(jnp.uint32) ^ jnp.uint32(seed)
+    x ^= x >> 16
+    x = x * jnp.uint32(MIX32_A)
+    x ^= x >> 13
+    x = x * jnp.uint32(MIX32_B)
+    x ^= x >> 16
+    return x
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("optimizer", "num_rows", "seed"),
+    donate_argnums=(0, 1, 2, 3),
+)
+def dense_scan_train_step(
+    value: jax.Array,
+    state: Dict[str, jax.Array],
+    bias: jax.Array,
+    bias_state: Dict[str, jax.Array],
+    keys_block: jax.Array,
+    labels_block: jax.Array,
+    optimizer: ServerOptimizer,
+    num_rows: int,
+    seed: int = 0,
+):
+    """K dense-apply LR steps in ONE XLA program (``lax.scan`` over steps).
+
+    The tunnel/PCIe-bound single-chip path: raw uint32 keys ``[K, B, nnz]``
+    ship in one transfer (half the bytes of int32 slot ids computed on host,
+    and K× fewer dispatches), the hashing trick runs on device via
+    :func:`mix32_jax`, and each scan iteration is the ``dense_fused_impl``
+    update.  PAD positions (key == ``0xFFFFFFFF``, the uint32 image of
+    ``PAD_KEY``) route to the table's trash row like the host path; real keys
+    must therefore be < 2**32 - 1.  Returns
+    ``(value, state, bias, bias_state, losses [K])``.
+    """
+
+    def body(carry, xs):
+        value, state, bias, bias_state = carry
+        keys, labels = xs
+        slots = jnp.where(
+            keys == jnp.uint32(0xFFFF_FFFF),
+            jnp.int32(num_rows),  # trash row of the [rows + 1] table
+            (mix32_jax(keys, seed) % jnp.uint32(num_rows)).astype(jnp.int32),
+        )
+        value, state, bias, bias_state, loss = dense_fused_impl(
+            value, state, bias, bias_state, slots, labels, optimizer
+        )
+        return (value, state, bias, bias_state), loss
+
+    (value, state, bias, bias_state), losses = jax.lax.scan(
+        body, (value, state, bias, bias_state), (keys_block, labels_block)
+    )
+    return value, state, bias, bias_state, losses
+
+
 def eval_logits(
     value: jax.Array,
     state: Dict[str, jax.Array],

@@ -47,6 +47,34 @@ def mix64(x: np.ndarray, seed: int | np.uint64 = 0) -> np.ndarray:
     return x
 
 
+#: murmur3 fmix32 constants — the 32-bit avalanche used when hashing happens
+#: ON DEVICE (TPU has no native uint64).  ``models/linear.py`` ``mix32_jax``
+#: imports these so the host/device twins stay bit-identical by construction.
+MIX32_A = 0x85EB_CA6B
+MIX32_B = 0xC2B2_AE35
+
+#: uint32 image of PAD_KEY under truncation; reserved on the device-hash
+#: path (keys must be < 2**32 - 1 there).
+PAD_KEY32 = np.uint32(0xFFFF_FFFF)
+
+
+def mix32(x: np.ndarray, seed: int | np.uint32 = 0) -> np.ndarray:
+    """murmur3 fmix32 avalanche, vectorized over uint32 arrays.
+
+    Host twin of the device-side ``mix32_jax``: both produce identical slot
+    assignments, so host preprocessing and device hashing interoperate.
+    """
+    x = np.asarray(x, dtype=np.uint32)
+    with np.errstate(over="ignore"):
+        x = x ^ np.uint32(seed)
+        x ^= x >> np.uint32(16)
+        x *= np.uint32(MIX32_A)
+        x ^= x >> np.uint32(13)
+        x *= np.uint32(MIX32_B)
+        x ^= x >> np.uint32(16)
+    return x
+
+
 def bucket_size(n: int, *, min_bucket: int = 256) -> int:
     """Round ``n`` up to the next power-of-two bucket (>= min_bucket).
 
@@ -158,19 +186,33 @@ class HashLocalizer:
     scheme and the multi-worker counterpart of :class:`Localizer`.
     """
 
-    def __init__(self, capacity: int, seed: int = 0):
+    def __init__(self, capacity: int, seed: int = 0, hash_bits: int = 64):
         if not (0 < capacity < 2**31 - 1):
             raise ValueError(
                 "capacity must fit int32 row ids (shard billion-row tables "
                 "across servers / mesh axes instead)"
             )
+        if hash_bits not in (32, 64):
+            raise ValueError("hash_bits must be 32 or 64")
         self.capacity = capacity
         self.seed = seed
+        #: 32 = murmur fmix32 on truncated keys, matching the device-side
+        #: ``models.linear.mix32_jax`` (TPU has no uint64); keys must fit
+        #: uint32 for collision behavior to stay key-space-uniform.
+        self.hash_bits = hash_bits
         self.overflowed = True  # collisions always possible
 
     def assign(self, unique_keys: np.ndarray) -> np.ndarray:
         keys = np.asarray(unique_keys, dtype=np.uint64)
-        slots = (mix64(keys, self.seed) % np.uint64(self.capacity)).astype(np.int32)
+        if self.hash_bits == 32:
+            slots = (
+                mix32(keys.astype(np.uint32), np.uint32(self.seed))
+                % np.uint32(self.capacity)
+            ).astype(np.int32)
+        else:
+            slots = (
+                mix64(keys, self.seed) % np.uint64(self.capacity)
+            ).astype(np.int32)
         return np.where(keys == PAD_KEY, np.int32(self.capacity), slots)
 
 

@@ -1,0 +1,169 @@
+"""Host-side tracing: spans, latency histograms, chrome-trace export.
+
+SURVEY.md §5 tracing plan: the reference has only ad-hoc timing macros and
+``/proc`` polling (``util/resource_usage.h``, ``system/network_usage.h``
+[U]); the rebuild gets a real tracer — Push/Pull latency histograms on the
+host path, exportable timelines, and a ``jax.profiler`` hook for the device
+side (TensorBoard traces with ICI utilization).
+
+Design: recording a span is two ``perf_counter`` calls and one deque append
+under a lock (~1 microsecond) so the tracer can stay on in production; the
+module-level :data:`NULL_TRACER` short-circuits to nothing for hot loops
+that want zero overhead.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Dict, Iterator, List, Optional, Tuple
+
+#: one recorded span: (name, start_s, duration_s, thread_id, attrs)
+Span = Tuple[str, float, float, int, Optional[dict]]
+
+
+class Tracer:
+    """Thread-safe span recorder with bounded memory."""
+
+    def __init__(self, *, capacity: int = 100_000, enabled: bool = True) -> None:
+        self.enabled = enabled
+        self._spans: collections.deque[Span] = collections.deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._t0 = time.perf_counter()
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs) -> Iterator[None]:
+        if not self.enabled:
+            yield
+            return
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            dur = time.perf_counter() - start
+            with self._lock:
+                self._spans.append(
+                    (name, start - self._t0, dur, threading.get_ident(),
+                     attrs or None)
+                )
+
+    def record(self, name: str, duration_s: float, **attrs) -> None:
+        """Record an externally timed span (e.g. from a callback)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._spans.append(
+                (name, time.perf_counter() - self._t0 - duration_s,
+                 duration_s, threading.get_ident(), attrs or None)
+            )
+
+    def spans(self, name: Optional[str] = None) -> List[Span]:
+        with self._lock:
+            out = list(self._spans)
+        return out if name is None else [s for s in out if s[0] == name]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+    # -- aggregation ---------------------------------------------------------
+    def histogram(self, name: str) -> dict:
+        """Latency stats for one span name (the Push/Pull histogram)."""
+        durs = sorted(s[2] for s in self.spans(name))
+        if not durs:
+            return {"name": name, "count": 0}
+        n = len(durs)
+
+        def pct(p: float) -> float:
+            return durs[min(n - 1, int(p * n))]
+
+        return {
+            "name": name,
+            "count": n,
+            "total_s": sum(durs),
+            "mean_us": 1e6 * sum(durs) / n,
+            "p50_us": 1e6 * pct(0.50),
+            "p90_us": 1e6 * pct(0.90),
+            "p99_us": 1e6 * pct(0.99),
+            "max_us": 1e6 * durs[-1],
+        }
+
+    def summary(self) -> Dict[str, dict]:
+        """Histogram per distinct span name."""
+        return {name: self.histogram(name) for name in
+                sorted({s[0] for s in self.spans()})}
+
+    # -- export --------------------------------------------------------------
+    def dump_chrome_trace(self, path: str) -> None:
+        """Write the spans as a chrome://tracing / Perfetto JSON timeline."""
+        events = [
+            {
+                "name": name,
+                "ph": "X",
+                "ts": start * 1e6,
+                "dur": dur * 1e6,
+                "pid": os.getpid(),
+                "tid": tid,
+                **({"args": attrs} if attrs else {}),
+            }
+            for name, start, dur, tid, attrs in self.spans()
+        ]
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events}, f)
+
+    def dump_jsonl(self, path: str) -> None:
+        with open(path, "w") as f:
+            for name, start, dur, tid, attrs in self.spans():
+                f.write(
+                    json.dumps(
+                        {"name": name, "start_s": start, "dur_s": dur,
+                         "tid": tid, "attrs": attrs}
+                    )
+                    + "\n"
+                )
+
+
+#: shared do-nothing tracer for hot paths with tracing off
+NULL_TRACER = Tracer(enabled=False)
+
+
+@contextlib.contextmanager
+def jax_profile(logdir: str) -> Iterator[None]:
+    """Device-side profile: wraps ``jax.profiler.trace`` (TensorBoard).
+
+    The host Tracer covers Van/host latency; this captures the XLA timeline
+    (HBM traffic, ICI collectives) for the same window.
+    """
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def resource_usage() -> dict:
+    """Process CPU/memory snapshot (reference ``util/resource_usage.h`` [U]).
+
+    Reads ``/proc`` directly (Linux); suitable as heartbeat ``stats`` payload.
+    """
+    out: dict = {"time": time.time()}
+    try:
+        with open("/proc/self/stat") as f:
+            stat = f.read()
+        # field 2 is "(comm)" and may itself contain spaces/parens — split
+        # only AFTER the last ')', then index relative to field 3 ("state")
+        parts = stat[stat.rindex(")") + 2 :].split()
+        tick = os.sysconf("SC_CLK_TCK")
+        out["cpu_user_s"] = int(parts[11]) / tick  # utime (field 14)
+        out["cpu_sys_s"] = int(parts[12]) / tick  # stime (field 15)
+        out["threads"] = int(parts[17])  # num_threads (field 20)
+        out["rss_mb"] = int(parts[21]) * os.sysconf("SC_PAGE_SIZE") / 2**20
+    except (OSError, IndexError, ValueError):
+        pass  # non-Linux: time-only heartbeat stats
+    return out

@@ -1,0 +1,114 @@
+"""Tracing subsystem: spans, histograms, exports, KV-layer wiring."""
+
+import json
+import threading
+import time
+
+import numpy as np
+
+from parameter_server_tpu.config import OptimizerConfig, TableConfig
+from parameter_server_tpu.core.postoffice import Postoffice
+from parameter_server_tpu.core.van import LoopbackVan
+from parameter_server_tpu.kv.server import KVServer
+from parameter_server_tpu.kv.worker import KVWorker
+from parameter_server_tpu.utils.trace import NULL_TRACER, Tracer, resource_usage
+
+
+def test_span_recording_and_histogram():
+    tr = Tracer()
+    for i in range(20):
+        with tr.span("op", i=i):
+            time.sleep(0.001)
+    h = tr.histogram("op")
+    assert h["count"] == 20
+    assert h["p50_us"] >= 1000  # slept >= 1ms
+    assert h["p99_us"] >= h["p50_us"]
+    assert h["max_us"] >= h["p99_us"]
+    assert tr.histogram("missing")["count"] == 0
+    assert "op" in tr.summary()
+
+
+def test_span_thread_safety_and_capacity():
+    tr = Tracer(capacity=100)
+
+    def worker():
+        for _ in range(100):
+            with tr.span("w"):
+                pass
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(tr.spans("w")) == 100  # bounded by capacity, no crash
+
+
+def test_null_tracer_records_nothing():
+    with NULL_TRACER.span("x"):
+        pass
+    NULL_TRACER.record("y", 0.5)
+    assert NULL_TRACER.spans() == []
+
+
+def test_exports(tmp_path):
+    tr = Tracer()
+    with tr.span("a", table="w"):
+        pass
+    tr.record("b", 0.002)
+    chrome = tmp_path / "trace.json"
+    tr.dump_chrome_trace(str(chrome))
+    events = json.loads(chrome.read_text())["traceEvents"]
+    assert {e["name"] for e in events} == {"a", "b"}
+    assert all(e["ph"] == "X" and "dur" in e for e in events)
+    assert any(e.get("args") == {"table": "w"} for e in events)
+
+    jl = tmp_path / "trace.jsonl"
+    tr.dump_jsonl(str(jl))
+    rows = [json.loads(line) for line in jl.read_text().splitlines()]
+    assert len(rows) == 2 and rows[1]["dur_s"] == 0.002
+
+
+def test_resource_usage_fields():
+    ru = resource_usage()
+    assert ru["rss_mb"] > 1.0
+    assert ru["cpu_user_s"] >= 0.0
+    assert ru["threads"] >= 1
+
+
+def test_kv_layer_traced_push_pull():
+    van = LoopbackVan()
+    try:
+        cfgs = {
+            "w": TableConfig(
+                name="w", rows=500, dim=2,
+                optimizer=OptimizerConfig(kind="sgd", learning_rate=1.0),
+            )
+        }
+        server_tracer = Tracer()
+        worker_tracer = Tracer()
+        servers = [
+            KVServer(
+                Postoffice(f"S{i}", van), cfgs, i, 2, tracer=server_tracer
+            )
+            for i in range(2)
+        ]
+        worker = KVWorker(
+            Postoffice("W0", van), cfgs, 2, min_bucket=16, tracer=worker_tracer
+        )
+        keys = np.arange(40, dtype=np.uint64)
+        for _ in range(3):
+            worker.wait(
+                worker.push("w", keys, np.ones((40, 2), np.float32)), timeout=10
+            )
+            worker.pull_sync("w", keys, timeout=10)
+        s = worker_tracer.summary()
+        assert s["kv.push"]["count"] == 3
+        assert s["kv.pull.wait"]["count"] == 3
+        ss = server_tracer.summary()
+        # both servers share the tracer: 3 pushes+pulls x 2 servers
+        assert ss["kv.server.push"]["count"] == 6
+        assert ss["kv.server.pull"]["count"] == 6
+        assert ss["kv.server.push"]["mean_us"] > 0
+    finally:
+        van.close()
