@@ -56,16 +56,17 @@ class CheckpointInfo:
     extras: Dict[str, Any]
 
 
-def save_shard(
+def save_arrays_shard(
     root: str,
     step: int,
     table_name: str,
-    table: KVTable,
     server_index: int,
     num_servers: int,
     row_offset: int,
+    value: np.ndarray,
+    state: Dict[str, np.ndarray],
 ) -> str:
-    """Write one server's row-range of one table (value + optimizer state).
+    """Write one server's row-range as raw arrays (the low-level writer).
 
     Safe to call concurrently from all servers: each writes a distinct file
     via an adjacent temp name + atomic rename.
@@ -74,11 +75,11 @@ def save_shard(
     os.makedirs(step_dir, exist_ok=True)
     path = _shard_path(step_dir, table_name, server_index, num_servers)
     arrays = {
-        "value": np.asarray(table.value)[: table.rows],
+        "value": np.asarray(value),
         "row_offset": np.asarray(row_offset, dtype=np.int64),
     }
-    for k, v in table.state.items():
-        arrays[f"state.{k}"] = np.asarray(v)[: table.rows]
+    for k, v in state.items():
+        arrays[f"state.{k}"] = np.asarray(v)
     fd, tmp = tempfile.mkstemp(dir=step_dir, suffix=".tmp")
     try:
         with os.fdopen(fd, "wb") as f:
@@ -89,6 +90,31 @@ def save_shard(
             os.unlink(tmp)
         raise
     return path
+
+
+def save_shard(
+    root: str,
+    step: int,
+    table_name: str,
+    table: KVTable,
+    server_index: int,
+    num_servers: int,
+    row_offset: int,
+) -> str:
+    """Write one KVTable shard's row-range (value + optimizer state).
+
+    The trash row (last) is excluded — it is reconstructed on restore.
+    """
+    return save_arrays_shard(
+        root,
+        step,
+        table_name,
+        server_index,
+        num_servers,
+        row_offset,
+        np.asarray(table.value)[: table.rows],
+        {k: np.asarray(v)[: table.rows] for k, v in table.state.items()},
+    )
 
 
 def finalize(
@@ -189,6 +215,26 @@ def _load_range(
     return {k: np.concatenate(v, axis=0) for k, v in pieces.items()}
 
 
+def load_arrays_shard(
+    root: str,
+    step: int,
+    table_name: str,
+    server_index: int,
+    num_servers: int,
+) -> Dict[str, np.ndarray]:
+    """Read this server's (possibly re-sharded) row-range as raw arrays.
+
+    ``num_servers`` is the NEW server count; the saved count comes from the
+    manifest.  Returns ``{"value": ..., "state.<k>": ...}``.
+    """
+    info = read_info(root, step)
+    rows = info.tables[table_name]
+    saved = RangePartition(rows, info.num_servers)
+    off = RangePartition(rows, num_servers).offsets
+    lo, hi = int(off[server_index]), int(off[server_index + 1])
+    return _load_range(_step_dir(root, step), table_name, saved, lo, hi)
+
+
 def restore_shard(
     root: str,
     step: int,
@@ -202,17 +248,12 @@ def restore_shard(
     ``num_servers`` is the NEW server count; the saved count comes from the
     manifest.  The table's trash row is reset to init fills.
     """
-    info = read_info(root, step)
-    rows = info.tables[table_name]
-    saved = RangePartition(rows, info.num_servers)
-    new = RangePartition(rows, num_servers)
-    off = new.offsets
-    lo, hi = int(off[server_index]), int(off[server_index + 1])
-    if hi - lo != table.rows:
+    arrays = load_arrays_shard(root, step, table_name, server_index, num_servers)
+    if arrays["value"].shape[0] != table.rows:
         raise ValueError(
-            f"table shard rows {table.rows} != partition range {hi - lo}"
+            f"table shard rows {table.rows} != saved range "
+            f"{arrays['value'].shape[0]}"
         )
-    arrays = _load_range(_step_dir(root, step), table_name, saved, lo, hi)
     import jax.numpy as jnp
 
     fills = table.optimizer.state_shapes()

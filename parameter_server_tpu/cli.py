@@ -94,7 +94,34 @@ def build_parser() -> argparse.ArgumentParser:
 
     apps = sub.add_parser("apps", help="list registered apps")
     apps.set_defaults(fn=_cmd_apps)
+
+    la = sub.add_parser(
+        "launch",
+        help="spawn scheduler+servers+workers as OS processes over TcpVan",
+    )
+    la.add_argument("--workers", type=int, default=2)
+    la.add_argument("--servers", type=int, default=2)
+    la.add_argument("--steps", type=int, default=20)
+    la.add_argument("--rows", type=int, default=1 << 14)
+    la.add_argument("--batch-size", type=int, default=256)
+    la.add_argument("--ckpt-root", default=None)
+    la.set_defaults(fn=_cmd_launch)
     return p
+
+
+def _cmd_launch(args: argparse.Namespace) -> int:
+    from parameter_server_tpu.launch import launch
+
+    result = launch(
+        num_workers=args.workers,
+        num_servers=args.servers,
+        steps=args.steps,
+        rows=args.rows,
+        batch_size=args.batch_size,
+        ckpt_root=args.ckpt_root,
+    )
+    print(json.dumps(result))
+    return 0 if all(rc == 0 for rc in result["returncodes"]) else 1
 
 
 def main(argv=None) -> int:

@@ -55,6 +55,10 @@ class NodeInfo:
     #: wall time of the last heartbeat seen by the scheduler.
     last_seen: float = 0.0
     alive: bool = True
+    #: (host, port) the node's Van listens on (multi-process TcpVan runs;
+    #: None on an in-process LoopbackVan).  Broadcast with the table so
+    #: every process can route to every other.
+    address: Optional[list] = None
 
 
 class NodeAssigner:
@@ -96,14 +100,21 @@ class Manager(Customer):
         num_servers: int,
         key_space: int = 1 << 20,
         heartbeat_timeout: float = 5.0,
+        advertise: Optional[tuple] = None,
     ) -> None:
+        """``advertise``: this node's Van (host, port) for multi-process
+        clusters — carried in REGISTER and broadcast with the node table so
+        peers can ``van.add_route`` to each other."""
         super().__init__(self.CUSTOMER_NAME, post)
+        self.advertise = advertise
         self.role = node_role(post.node_id)
         self.num_workers = num_workers
         self.num_servers = num_servers
         self.assigner = NodeAssigner(key_space)
         self.heartbeat_timeout = heartbeat_timeout
         self._table: Dict[str, NodeInfo] = {}
+        self._barriers: Dict[str, set] = {}
+        self._barrier_acks: Dict[str, set] = {}
         self._table_lock = threading.Lock()
         self._ready = threading.Event()
         #: elasticity callbacks: fn(node_id) on death / (re)join.
@@ -130,14 +141,13 @@ class Manager(Customer):
         from one thread register them all first, then ``wait_ready`` each —
         otherwise node k would block on nodes k+1.. ever registering).
         """
+        payload = {"cmd": REGISTER, "role": self.role.value}
+        if self.advertise is not None:
+            payload["address"] = list(self.advertise)
         self.submit(
             [
                 Message(
-                    task=Task(
-                        TaskKind.CONTROL,
-                        self.name,
-                        payload={"cmd": REGISTER, "role": self.role.value},
-                    ),
+                    task=Task(TaskKind.CONTROL, self.name, payload=payload),
                     recver=SCHEDULER,
                 )
             ]
@@ -182,14 +192,112 @@ class Manager(Customer):
             self._on_remove_node(msg)
         elif cmd == HEARTBEAT:
             self._on_heartbeat(msg)
+        elif cmd == BARRIER:
+            return self._on_barrier(msg)
         return msg.reply()
+
+    # -- barrier (poll-based; replies carry the arrival count) ---------------
+    def _on_barrier(self, msg: Message) -> Message:
+        import numpy as np
+
+        name = msg.task.payload["name"]
+        with self._table_lock:
+            arrivals = self._barriers.setdefault(name, set())
+            if msg.task.payload.get("enter"):
+                arrivals.add(msg.sender)
+            if msg.task.payload.get("ack"):
+                self._barrier_acks.setdefault(name, set()).add(msg.sender)
+            count = len(arrivals)
+        return msg.reply(values=[np.asarray([count], np.int64)])
+
+    def barrier(
+        self,
+        name: str,
+        expected: int,
+        *,
+        timeout: Optional[float] = 60.0,
+        poll: float = 0.05,
+    ) -> bool:
+        """Block until ``expected`` distinct nodes entered barrier ``name``.
+
+        Poll-based (the scheduler cannot defer replies), so it works across
+        processes over any Van.  Returns False on timeout.
+        """
+        deadline = None if timeout is None else time.monotonic() + timeout
+        enter = True
+        while deadline is None or time.monotonic() < deadline:
+            ts = self.submit(
+                [
+                    Message(
+                        task=Task(
+                            TaskKind.CONTROL,
+                            self.name,
+                            payload={"cmd": BARRIER, "name": name, "enter": enter},
+                        ),
+                        recver=SCHEDULER,
+                    )
+                ],
+                keep_responses=True,
+            )
+            left = None if deadline is None else max(deadline - time.monotonic(), 0.1)
+            ok = self.wait(ts, timeout=left)
+            responses = self.take_responses(ts)
+            if not ok or not responses:
+                return False
+            enter = False  # entered; subsequent rounds just poll
+            if int(responses[0].values[0][0]) >= expected:
+                # fire-and-forget ack so the scheduler can barrier_drain:
+                # it must outlive every participant still polling
+                self.submit(
+                    [
+                        Message(
+                            task=Task(
+                                TaskKind.CONTROL,
+                                self.name,
+                                payload={"cmd": BARRIER, "name": name, "ack": True},
+                            ),
+                            recver=SCHEDULER,
+                        )
+                    ]
+                )
+                return True
+            time.sleep(poll)
+        return False
+
+    def barrier_drain(
+        self,
+        name: str,
+        expected: int,
+        *,
+        timeout: Optional[float] = 60.0,
+        poll: float = 0.05,
+    ) -> bool:
+        """Scheduler: block until ``expected`` nodes ACKED barrier ``name``.
+
+        Call after :meth:`barrier` and before process exit — otherwise the
+        scheduler can die while a slow participant is still polling, and
+        that participant hangs until its own timeout (the classic
+        last-observer race).
+        """
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while deadline is None or time.monotonic() < deadline:
+            with self._table_lock:
+                n = len(self._barrier_acks.get(name, ()))
+            if n >= expected:
+                return True
+            time.sleep(poll)
+        return False
 
     def _on_register(self, msg: Message) -> None:
         assert self.role == NodeRole.SCHEDULER, "REGISTER sent to non-scheduler"
         info = NodeInfo(
             msg.sender, NodeRole(msg.task.payload["role"]),
             last_seen=time.monotonic(),
+            address=msg.task.payload.get("address"),
         )
+        addr = info.address
+        if addr and hasattr(self.post.van, "add_route"):
+            self.post.van.add_route(msg.sender, tuple(addr))
         with self._table_lock:
             self._table[msg.sender] = info
             workers = sum(
@@ -238,7 +346,15 @@ class Manager(Customer):
             for row in msg.task.payload["table"]:
                 row = dict(row)
                 row["role"] = NodeRole(row["role"])
-                self._table[row["node_id"]] = NodeInfo(**row)
+                info = NodeInfo(**row)
+                self._table[info.node_id] = info
+                # multi-process: learn routes to every peer from the table
+                if (
+                    info.address
+                    and info.node_id != self.post.node_id
+                    and hasattr(self.post.van, "add_route")
+                ):
+                    self.post.van.add_route(info.node_id, tuple(info.address))
         for cb in self.on_node_added:
             for row in msg.task.payload["table"]:
                 cb(row["node_id"] if isinstance(row, dict) else row.node_id)

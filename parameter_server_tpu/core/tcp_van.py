@@ -170,6 +170,11 @@ class TcpVan(Van):
         self._endpoints: Dict[str, _Endpoint] = {}
         self._routes: Dict[str, Tuple[str, int]] = {}
         self._conns: Dict[Tuple[str, int], int] = {}
+        #: sender node id -> native conn the last inbound frame arrived on.
+        #: Replies ride the requester's own connection (the ZMQ ROUTER
+        #: identity pattern), so a server can answer peers it has no route
+        #: for yet — e.g. a pull racing ahead of the node-table broadcast.
+        self._peer_conns: Dict[str, int] = {}
         self._link_locks: Dict[tuple, threading.Lock] = {}
         self._lock = threading.Lock()
         self._closed = threading.Event()
@@ -225,9 +230,7 @@ class TcpVan(Van):
         with self._lock:
             addr = self._routes.get(msg.recver)
         if addr is None:
-            with self._lock:
-                self.dropped_messages += 1
-            return False
+            return self._send_via_peer_conn(msg)
         if self.filter_chain is not None:
             # Stateful filters (key caching) need wire-FIFO per link: hold the
             # link lock across encode AND the socket write so a later encode
@@ -241,6 +244,29 @@ class TcpVan(Van):
                 msg = self.filter_chain.encode(msg)
                 return self._send_wire(serialize_message(msg), addr)
         return self._send_wire(serialize_message(msg), addr)
+
+    def _send_via_peer_conn(self, msg: Message) -> bool:
+        """No route: answer over the connection the peer last spoke on."""
+        with self._lock:
+            conn = self._peer_conns.get(msg.recver)
+        if conn is None or self._van is None:
+            with self._lock:
+                self.dropped_messages += 1
+            return False
+        # NOTE: filters are skipped on this path — filter state is keyed per
+        # link and the requester decodes replies with its own chain; the
+        # symmetric encode would need the same route-table entry we lack.
+        data = serialize_message(msg)
+        buf = ctypes.cast(ctypes.c_char_p(data), _u8p)
+        rc = self._lib.ps_van_send(self._van, conn, buf, len(data))
+        with self._lock:
+            if rc == 0:
+                self.sent_messages += 1
+            else:
+                self.dropped_messages += 1
+                if self._peer_conns.get(msg.recver) == conn:
+                    self._peer_conns.pop(msg.recver, None)  # stale conn
+        return rc == 0
 
     def _send_wire(self, data: bytes, addr: Tuple[str, int]) -> bool:
         if self._closed.is_set() or self._van is None:
@@ -309,6 +335,9 @@ class TcpVan(Van):
                 msg = deserialize_message(memoryview(raw))
             except Exception:
                 continue  # corrupt frame: drop (wire-level noise tolerance)
+            if msg.sender:
+                with self._lock:
+                    self._peer_conns[msg.sender] = conn.value
             try:
                 if self.filter_chain is not None:
                     with self._lock:

@@ -54,9 +54,12 @@ class DenseKVServer(Customer):
         """``specs``: table name -> (total_elements, optimizer config)."""
         super().__init__(name, post)
         self.server_index = server_index
+        self.num_servers = num_servers
+        self.offsets: Dict[str, np.ndarray] = {}
         self.segments: Dict[str, dict] = {}
         for t, (total, opt_cfg) in specs.items():
             off = segment_offsets(total, num_servers)
+            self.offsets[t] = off
             lo, hi = int(off[server_index]), int(off[server_index + 1])
             opt = make_optimizer(opt_cfg)
             if init_vectors and t in init_vectors:
@@ -78,6 +81,8 @@ class DenseKVServer(Customer):
             }
 
     def handle_request(self, msg: Message) -> Message:
+        if msg.task.kind == TaskKind.CONTROL:
+            return self._handle_control(msg)
         seg = self.segments[msg.task.payload["table"]]
         if msg.task.kind == TaskKind.PUSH:
             grad = jnp.asarray(msg.values[0]).reshape(-1, 1)
@@ -89,6 +94,47 @@ class DenseKVServer(Customer):
             w = seg["pull"](seg["value"], seg["state"])
             return msg.reply(values=[np.asarray(w).ravel()])
         raise ValueError(f"unsupported task kind {msg.task.kind}")
+
+    # -- checkpoint (dense analogue of KVServer's SaveModel path) ------------
+    def _handle_control(self, msg: Message) -> Message:
+        op = msg.task.payload.get("op")
+        if op == "save_model":
+            self.save_checkpoint(msg.task.payload["root"], msg.task.payload["step"])
+            return msg.reply()
+        if op == "load_model":
+            self.restore_checkpoint(msg.task.payload["root"], msg.task.payload["step"])
+            return msg.reply()
+        raise ValueError(f"unsupported control op {op!r}")
+
+    def save_checkpoint(self, root: str, step: int) -> None:
+        """Write this server's element-range of every dense vector."""
+        from parameter_server_tpu import checkpoint
+
+        for t, seg in self.segments.items():
+            checkpoint.save_arrays_shard(
+                root,
+                step,
+                t,
+                self.server_index,
+                self.num_servers,
+                int(self.offsets[t][self.server_index]),
+                np.asarray(seg["value"]),
+                {k: np.asarray(v) for k, v in seg["state"].items()},
+            )
+
+    def restore_checkpoint(self, root: str, step: int) -> None:
+        """Load this server's element-range (saved server count may differ)."""
+        from parameter_server_tpu import checkpoint
+
+        for t, seg in self.segments.items():
+            arrays = checkpoint.load_arrays_shard(
+                root, step, t, self.server_index, self.num_servers
+            )
+            seg["value"] = jnp.asarray(arrays["value"], jnp.float32)
+            seg["state"] = {
+                k: jnp.asarray(arrays[f"state.{k}"], jnp.float32)
+                for k in seg["state"]
+            }
 
 
 class DenseKVWorker(Customer):
@@ -157,6 +203,58 @@ class DenseKVWorker(Customer):
 
     def pull_sync(self, table: str, timeout: Optional[float] = None) -> np.ndarray:
         return self.pull_result(self.pull(table), timeout)
+
+    # -- checkpoint broadcast (mirrors KVWorker.save_model/load_model) -------
+    def save_model(
+        self,
+        root: str,
+        step: int,
+        *,
+        clocks: Optional[List[int]] = None,
+        extras: Optional[dict] = None,
+        timeout: Optional[float] = 600.0,
+    ) -> None:
+        """All servers write their element-ranges; then commit the manifest.
+
+        Use a root distinct from any sparse-table checkpoint root (one
+        manifest lists one worker's tables).
+        """
+        from parameter_server_tpu import checkpoint
+
+        ts = self._broadcast_control("save_model", {"root": root, "step": step})
+        if not self.wait(ts, timeout):
+            raise TimeoutError("dense save_model timed out")
+        self.check(ts)
+        self.take_responses(ts)
+        checkpoint.finalize(
+            root,
+            step,
+            self.num_servers,
+            {t: int(off[-1]) for t, off in self.offsets.items()},
+            clocks=clocks,
+            extras=extras,
+        )
+
+    def load_model(
+        self, root: str, step: int, *, timeout: Optional[float] = 600.0
+    ) -> None:
+        ts = self._broadcast_control("load_model", {"root": root, "step": step})
+        if not self.wait(ts, timeout):
+            raise TimeoutError("dense load_model timed out")
+        self.check(ts)
+        self.take_responses(ts)
+
+    def _broadcast_control(self, op: str, payload: dict) -> int:
+        msgs = [
+            Message(
+                task=Task(
+                    TaskKind.CONTROL, self.name, payload={"op": op, **payload}
+                ),
+                recver=server_id(s),
+            )
+            for s in range(self.num_servers)
+        ]
+        return self.submit(msgs, keep_responses=True)
 
 
 class PytreeCodec:

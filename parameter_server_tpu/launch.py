@@ -1,0 +1,275 @@
+"""Multi-process cluster launch over the native TCP Van.
+
+Reference analogue: ``script/local.sh`` — spawn scheduler + N servers + M
+workers as separate OS processes with role/topology from the environment
+(SURVEY.md §2 #23, §4 [U]).  The transport is the real DCN-plane
+``TcpVan`` on loopback, so this is also the multi-process integration test
+of the whole stack (the role loopback-ZMQ played for the reference): same
+code runs unmodified with remote addresses across hosts.
+
+Flow: the launcher picks a free port, spawns every role via
+``python -m parameter_server_tpu.launch --role ...``; nodes register with
+the scheduler carrying their Van address; the node-table broadcast gives
+every process routes to every other; workers train async-SGD sparse LR
+against the servers, synchronize on a Manager barrier, worker 0 saves the
+model, and each worker writes its losses to ``--outdir`` for the launcher
+to aggregate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Optional
+
+import numpy as np
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _build_cluster(args, role_port: int, setup=None):
+    """Common per-process setup: Van, Postoffice, Manager, registration.
+
+    ``setup(post)`` runs BEFORE registration — servers must bind their
+    KVServer customer first, because the moment the table broadcast lands,
+    workers may start sending Push/Pull at them.
+    """
+    from parameter_server_tpu.core.manager import Manager
+    from parameter_server_tpu.core.postoffice import Postoffice
+    from parameter_server_tpu.core.tcp_van import TcpVan
+
+    van = TcpVan(port=role_port)
+    if args.node_id != "H":
+        van.add_route("H", ("127.0.0.1", args.scheduler_port))
+    post = Postoffice(args.node_id, van)
+    mgr = Manager(
+        post,
+        num_workers=args.num_workers,
+        num_servers=args.num_servers,
+        advertise=van.address,
+        heartbeat_timeout=args.heartbeat_timeout,
+    )
+    result = setup(post) if setup is not None else None
+    if args.node_id != "H":
+        if not mgr.register_with_scheduler(timeout=60):
+            raise TimeoutError(f"{args.node_id}: node table never arrived")
+    else:
+        if not mgr.wait_ready(timeout=60):
+            raise TimeoutError("scheduler: not all nodes registered")
+    return van, post, mgr, result
+
+
+def _table_cfgs(args):
+    from parameter_server_tpu.config import OptimizerConfig, TableConfig
+
+    return {
+        "w": TableConfig(
+            name="w",
+            rows=args.rows,
+            dim=1,
+            optimizer=OptimizerConfig(kind="adagrad", learning_rate=0.1),
+        )
+    }
+
+
+def run_scheduler(args) -> int:
+    van, post, mgr, _ = _build_cluster(args, args.scheduler_port)
+    try:
+        _log(args, "ready; waiting on shutdown barrier")
+        # stay up until every node passed the final barrier
+        n_nodes = args.num_workers + args.num_servers
+        ok = mgr.barrier("shutdown", n_nodes + 1, timeout=args.run_timeout)
+        _log(args, f"shutdown barrier -> {ok}")
+        return 0
+    finally:
+        van.close()
+
+
+def run_server(args) -> int:
+    from parameter_server_tpu.kv.server import KVServer
+
+    index = int(args.node_id[1:])
+    van, post, mgr, _server = _build_cluster(
+        args,
+        0,
+        setup=lambda post: KVServer(
+            post, _table_cfgs(args), index, args.num_servers
+        ),
+    )
+    try:
+        _log(args, "serving; waiting on shutdown barrier")
+        n_nodes = args.num_workers + args.num_servers
+        ok = mgr.barrier("shutdown", n_nodes + 1, timeout=args.run_timeout)
+        _log(args, f"shutdown barrier -> {ok}")
+        return 0
+    finally:
+        van.close()
+        _log(args, "van closed")
+
+
+def _log(args, msg: str) -> None:
+    print(
+        f"[launch {args.node_id} {time.strftime('%H:%M:%S')}] {msg}",
+        file=sys.stderr,
+        flush=True,
+    )
+
+
+def run_worker(args) -> int:
+    import jax.numpy as jnp
+
+    from parameter_server_tpu.data.synthetic import SyntheticCTR
+    from parameter_server_tpu.kv.worker import KVWorker
+    from parameter_server_tpu.models import linear
+
+    van, post, mgr, _ = _build_cluster(args, 0)
+    try:
+        index = int(args.node_id[1:])
+        worker = KVWorker(post, _table_cfgs(args), args.num_servers)
+        data = SyntheticCTR(
+            key_space=4 * args.rows,
+            nnz=args.nnz,
+            batch_size=args.batch_size,
+            seed=100 + index,
+        )
+        _log(args, "training")
+        losses = []
+        for _ in range(args.steps):
+            keys, labels = data.next_batch()
+            w_pos = worker.pull_sync("w", keys, timeout=60)
+            g, _gb, loss = linear.grad_rows(jnp.asarray(w_pos), jnp.asarray(labels))
+            ts = worker.push("w", keys, np.asarray(g) / labels.shape[0])
+            if not worker.wait(ts, timeout=60):
+                raise TimeoutError("push not acked")
+            losses.append(float(loss))
+        _log(args, "trained; entering trained barrier")
+        # all workers done training before anyone saves (BSP-style epoch end)
+        if not mgr.barrier("trained", args.num_workers, timeout=args.run_timeout):
+            raise TimeoutError("trained barrier timed out")
+        _log(args, "trained barrier passed")
+        if index == 0 and args.ckpt_root:
+            worker.save_model(args.ckpt_root, step=args.steps)
+        if args.outdir:
+            out = os.path.join(args.outdir, f"{args.node_id}.json")
+            with open(out, "w") as f:
+                json.dump({"node": args.node_id, "losses": losses}, f)
+        n_nodes = args.num_workers + args.num_servers
+        ok = mgr.barrier("shutdown", n_nodes + 1, timeout=args.run_timeout)
+        _log(args, f"shutdown barrier -> {ok}")
+        return 0
+    finally:
+        van.close()
+
+
+def launch(
+    *,
+    num_workers: int = 2,
+    num_servers: int = 2,
+    steps: int = 20,
+    rows: int = 1 << 14,
+    batch_size: int = 256,
+    nnz: int = 8,
+    ckpt_root: Optional[str] = None,
+    run_timeout: float = 300.0,
+    python: str = sys.executable,
+) -> dict:
+    """Spawn the full cluster as OS processes; returns aggregated results."""
+    port = _free_port()
+    outdir = tempfile.mkdtemp(prefix="psx_launch_")
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    pypath = os.environ.get("PYTHONPATH", "")
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        PYTHONPATH=f"{repo_root}:{pypath}" if pypath else repo_root,
+    )
+
+    def spawn(role: str, node_id: str) -> subprocess.Popen:
+        cmd = [
+            python, "-m", "parameter_server_tpu.launch",
+            "--role", role, "--node-id", node_id,
+            "--scheduler-port", str(port),
+            "--num-workers", str(num_workers),
+            "--num-servers", str(num_servers),
+            "--steps", str(steps), "--rows", str(rows),
+            "--batch-size", str(batch_size), "--nnz", str(nnz),
+            "--outdir", outdir,
+            "--run-timeout", str(run_timeout),
+        ]
+        if ckpt_root:
+            cmd += ["--ckpt-root", ckpt_root]
+        return subprocess.Popen(cmd, env=env)
+
+    procs = [spawn("scheduler", "H")]
+    time.sleep(0.3)  # let the scheduler bind its fixed port first
+    procs += [spawn("server", f"S{i}") for i in range(num_servers)]
+    procs += [spawn("worker", f"W{i}") for i in range(num_workers)]
+
+    deadline = time.monotonic() + run_timeout
+    rcs = []
+    try:
+        for p in procs:
+            left = max(deadline - time.monotonic(), 1.0)
+            rcs.append(p.wait(timeout=left))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    losses = []
+    per_worker = {}
+    for i in range(num_workers):
+        path = os.path.join(outdir, f"W{i}.json")
+        if os.path.exists(path):
+            with open(path) as f:
+                row = json.load(f)
+            per_worker[row["node"]] = row["losses"]
+            losses.extend(row["losses"])
+    return {
+        "returncodes": rcs,
+        "workers_reported": sorted(per_worker),
+        "steps_total": len(losses),
+        "first_loss": float(np.mean(losses[:5])) if losses else None,
+        "final_loss": float(np.mean(losses[-5:])) if losses else None,
+    }
+
+
+def main(argv=None) -> int:
+    # cluster roles are host-side: never let the axon plugin grab the chip
+    # (its init can also block when the device relay is busy)
+    from parameter_server_tpu.utils.platform import force_cpu
+
+    force_cpu()
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--role", required=True,
+                   choices=["scheduler", "server", "worker"])
+    p.add_argument("--node-id", required=True)
+    p.add_argument("--scheduler-port", type=int, required=True)
+    p.add_argument("--num-workers", type=int, required=True)
+    p.add_argument("--num-servers", type=int, required=True)
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--rows", type=int, default=1 << 14)
+    p.add_argument("--batch-size", type=int, default=256)
+    p.add_argument("--nnz", type=int, default=8)
+    p.add_argument("--outdir", default=None)
+    p.add_argument("--ckpt-root", default=None)
+    p.add_argument("--heartbeat-timeout", type=float, default=30.0)
+    p.add_argument("--run-timeout", type=float, default=300.0)
+    args = p.parse_args(argv)
+    return {"scheduler": run_scheduler, "server": run_server,
+            "worker": run_worker}[args.role](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,40 @@
+"""JAX platform forcing for subprocess roles and CPU-only tools.
+
+The dev image's sitecustomize registers an experimental single-TPU PJRT
+plugin in every interpreter; jax initializes all registered plugins at
+backend discovery, which can block (the plugin dials a device-relay
+service) even when ``JAX_PLATFORMS=cpu``.  Launched cluster roles are
+host-side programs that must never touch the chip, so they unregister
+non-standard plugin factories BEFORE the first backend access — the same
+approach as ``tests/conftest.py``.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu(n_devices: int = 0) -> None:
+    """Pin this process to the CPU backend (optionally n virtual devices).
+
+    Must run before any jax operation initializes a backend; afterwards it
+    is a no-op (jax refuses to switch initialized platforms).
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if n_devices:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n_devices}"
+            ).strip()
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        from jax._src import xla_bridge as _xb
+
+        for name in list(getattr(_xb, "_backend_factories", {})):
+            if name not in ("cpu", "tpu", "gpu", "cuda", "rocm"):
+                _xb._backend_factories.pop(name, None)
+    except Exception:
+        pass  # already initialized or internals moved: best effort

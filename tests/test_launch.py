@@ -1,0 +1,68 @@
+"""Multi-process cluster launch over TcpVan — the script/local.sh analogue.
+
+Spawns a REAL scheduler + servers + workers as OS processes; the transport,
+registration, route learning from the node-table broadcast, training,
+barrier, and checkpoint broadcast all run cross-process.  (SURVEY.md §4:
+this is how the reference tested multi-node on one host.)
+"""
+
+import numpy as np
+import pytest
+
+from parameter_server_tpu import checkpoint, native
+from parameter_server_tpu.core.manager import Manager, launch_local_cluster
+from parameter_server_tpu.core.van import LoopbackVan
+from parameter_server_tpu.launch import launch
+
+if native.load("tcpvan") is None:  # pragma: no cover
+    pytest.skip("no native toolchain for tcpvan", allow_module_level=True)
+
+
+def test_barrier_in_process():
+    van = LoopbackVan()
+    try:
+        sched, managers, posts = launch_local_cluster(
+            van, num_workers=2, num_servers=1
+        )
+        import threading
+
+        results = {}
+
+        def enter(nid):
+            results[nid] = managers[nid].barrier("b1", 3, timeout=20)
+
+        threads = [
+            threading.Thread(target=enter, args=(nid,))
+            for nid in ("H", "S0", "W0")
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert all(results.values())
+        # a barrier short of its quorum times out
+        assert managers["W1"].barrier("b2", 5, timeout=0.5) is False
+    finally:
+        van.close()
+
+
+def test_multiprocess_launch_trains_and_checkpoints(tmp_path):
+    ckpt = str(tmp_path / "ckpt")
+    result = launch(
+        num_workers=2,
+        num_servers=2,
+        steps=12,
+        rows=4096,
+        batch_size=128,
+        ckpt_root=ckpt,
+        run_timeout=240.0,
+    )
+    assert result["returncodes"] == [0] * 5, result
+    assert result["workers_reported"] == ["W0", "W1"]
+    assert result["steps_total"] == 24
+    assert result["final_loss"] < result["first_loss"], result
+    # worker 0's save_model committed a readable checkpoint
+    step = checkpoint.latest_step(ckpt)
+    assert step == 12
+    w = checkpoint.load_global_weights(ckpt, step, "w")
+    assert w.shape == (4096, 1) and np.abs(w).sum() > 0
