@@ -10,8 +10,9 @@ implementations planned:
   ``script/local.sh`` integration tests, SURVEY.md §4) and the single-host
   runtime, where scheduler/servers/workers are Python objects sharing one
   process and the actual tensor traffic rides XLA, not the Van.
-- A DCN Van (``core/dcn_van.py``, later round): cross-host async Push/Pull
-  over TCP for multi-pod deployments; same interface.
+- :class:`~parameter_server_tpu.core.tcp_van.TcpVan`: the DCN-plane Van —
+  cross-host async Push/Pull over native TCP (``native/src/tcpvan.cc``);
+  same interface.
 
 Fault injection is first-class: :meth:`LoopbackVan.disconnect` makes a node
 unreachable (dropped messages), emulating a dead socket for failure-path

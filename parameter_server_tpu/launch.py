@@ -92,6 +92,15 @@ def run_scheduler(args) -> int:
         n_nodes = args.num_workers + args.num_servers
         ok = mgr.barrier("shutdown", n_nodes + 1, timeout=args.run_timeout)
         _log(args, f"shutdown barrier -> {ok}")
+        # Last-observer protocol: the scheduler must outlive every participant
+        # still polling the barrier, or their next poll hits a closed van and
+        # spuriously returns False.  barrier() acks on success; drain all
+        # n_nodes + 1 acks (incl. our own) before tearing the van down.
+        if ok:
+            drained = mgr.barrier_drain(
+                "shutdown", n_nodes + 1, timeout=min(args.run_timeout, 60.0)
+            )
+            _log(args, f"shutdown barrier drained -> {drained}")
         return 0
     finally:
         van.close()

@@ -166,6 +166,25 @@ class LocalLRTrainer:
         """
         if not self.device_hash:
             raise ValueError("step_block requires device_hash=True")
+        keys_block = np.asarray(keys_block)
+        if keys_block.dtype != np.uint32:
+            # The device-hash path truncates to uint32; keys >= 2**32 - 1
+            # would silently wrap (or alias PAD_KEY32 and route to the trash
+            # row), corrupting training with no error — enforce the
+            # documented "< 2**32 - 1 unless PAD" contract instead.
+            from parameter_server_tpu.utils.keys import PAD_KEY
+
+            kb = keys_block.astype(np.uint64)  # signed -1 coerces to PAD_KEY
+            # cheap scalar early-out: only blocks containing a suspicious key
+            # (>= uint32 max; PAD_KEY itself is uint64 max) pay for the mask
+            if int(kb.max(initial=0)) >= 0xFFFF_FFFF:
+                bad = (kb != PAD_KEY) & (kb >= np.uint64(0xFFFF_FFFF))
+                if bad.any():
+                    raise ValueError(
+                        "step_block(device_hash): keys must be < 2**32 - 1 "
+                        f"(or PAD_KEY); got {int(kb[bad][0])}"
+                    )
+            keys_block = kb
         t = self.table
         (
             t.value,
@@ -178,7 +197,7 @@ class LocalLRTrainer:
             t.state,
             self.bias,
             self.bias_state,
-            jnp.asarray(np.asarray(keys_block).astype(np.uint32)),
+            jnp.asarray(keys_block.astype(np.uint32, copy=False)),
             jnp.asarray(labels_block),
             self.optimizer,
             self.cfg.rows,

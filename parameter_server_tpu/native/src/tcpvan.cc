@@ -269,6 +269,11 @@ void ps_van_disconnect(void* vvan, int conn_id) {
     if (c->id == conn_id) { conn = c.get(); break; }
   if (!conn) return;
   if (conn->open.exchange(false)) ::shutdown(conn->fd, SHUT_RDWR);
+  // Order the open=false store with the recv thread's backpressure predicate:
+  // without holding q_mu between the store and the notify, the thread can
+  // evaluate its predicate (open still true), lose the notify, then park
+  // forever — and the join() below would wedge every caller on conns_mu.
+  { std::lock_guard<std::mutex> qlk(van->q_mu); }
   van->q_cv.notify_all();  // wake its recv thread if parked on backpressure
   if (conn->recv_thread.joinable()) conn->recv_thread.join();
   std::lock_guard<std::mutex> send_lk(conn->send_mu);  // no in-flight writer
@@ -296,6 +301,10 @@ void ps_van_close(void* vvan) {
     for (auto& c : van->conns)
       if (c->open.exchange(false)) ::shutdown(c->fd, SHUT_RDWR);
   }
+  // Same lost-wakeup ordering as ps_van_disconnect: a recv thread parked on
+  // the backpressure predicate must observe running/open flipped before the
+  // notify, or the joins below hang.
+  { std::lock_guard<std::mutex> qlk(van->q_mu); }
   van->q_cv.notify_all();
   if (van->accept_thread.joinable()) van->accept_thread.join();
   {
