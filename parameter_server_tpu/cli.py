@@ -106,7 +106,41 @@ def build_parser() -> argparse.ArgumentParser:
     la.add_argument("--batch-size", type=int, default=256)
     la.add_argument("--ckpt-root", default=None)
     la.set_defaults(fn=_cmd_launch)
+
+    sp = sub.add_parser(
+        "launch-spmd",
+        help="multi-host GSPMD job: N processes joined by jax.distributed "
+        "(pod runtime; CPU-sim with --cpu-devices)",
+    )
+    sp.add_argument("--num-procs", type=int, default=2)
+    sp.add_argument("--cpu-devices", type=int, default=4,
+                    help="virtual CPU devices per process (0 = real chips)")
+    sp.add_argument("--steps", type=int, default=8)
+    sp.add_argument("--rows", type=int, default=1 << 12)
+    sp.add_argument("--global-batch", type=int, default=256)
+    sp.add_argument("--mesh-data", type=int, default=2)
+    sp.set_defaults(fn=_cmd_launch_spmd)
     return p
+
+
+def _cmd_launch_spmd(args: argparse.Namespace) -> int:
+    from parameter_server_tpu.launch_spmd import launch_spmd
+
+    result = launch_spmd(
+        num_procs=args.num_procs,
+        cpu_devices=args.cpu_devices,
+        steps=args.steps,
+        rows=args.rows,
+        global_batch=args.global_batch,
+        mesh_data=args.mesh_data,
+    )
+    losses = result["losses"].get(0, [])
+    print(json.dumps({
+        "returncodes": result["returncodes"],
+        "first_loss": losses[0] if losses else None,
+        "final_loss": losses[-1] if losses else None,
+    }))
+    return 0 if all(rc == 0 for rc in result["returncodes"]) else 1
 
 
 def _cmd_launch(args: argparse.Namespace) -> int:

@@ -1,0 +1,205 @@
+"""Multi-host SPMD job launch: one process per pod host, global GSPMD mesh.
+
+The counterpart of ``launch.py`` (which spawns the *PS role* topology over
+TcpVan) for the pure-GSPMD data plane: a v5e-16 pod runs 4 host processes,
+each owning 4 chips, joined by ``jax.distributed`` into one global mesh
+(SURVEY.md §7 step 4; VERDICT r1 missing #2).  On dev machines the same job
+runs as N processes x K virtual CPU devices — identical program, Gloo
+collectives instead of ICI.
+
+Per-process flow (:func:`main`): ``distributed.initialize`` -> global
+``(data, model)`` mesh -> :class:`~parameter_server_tpu.parallel.lr_spmd.SpmdLRTrainer`
+row-sharded across all hosts -> each step, every process generates the SAME
+deterministic global batch (seeded stream, the reference's WorkloadPool
+determinism) and feeds only its :func:`~parameter_server_tpu.parallel.distributed.local_batch_slice`
+of it.  Process 0 writes the loss trajectory for the launcher to aggregate.
+
+``launch_spmd`` spawns the whole job locally (the CPU-sim pod) and returns
+the losses — used by tests and ``__graft_entry__.dryrun_multichip`` to prove
+multi-process GSPMD training matches single-process loss-for-loss.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Optional
+
+from parameter_server_tpu.launch import _free_port
+
+
+def run_job(
+    *,
+    coordinator: Optional[str],
+    num_procs: int,
+    proc_id: int,
+    cpu_devices: int,
+    steps: int,
+    rows: int,
+    global_batch: int,
+    nnz: int,
+    mesh_data: int,
+    seed: int = 0,
+) -> list[float]:
+    """One process's share of the SPMD LR job; returns per-step losses.
+
+    Losses are global (replicated out of the jit step), so every process
+    returns the same trajectory — asserting them equal across processes is
+    part of the test contract.
+    """
+    from parameter_server_tpu.parallel import distributed
+
+    distributed.initialize(
+        coordinator, num_procs, proc_id, cpu_devices=cpu_devices
+    )
+    import jax
+
+    from parameter_server_tpu.config import OptimizerConfig, TableConfig
+    from parameter_server_tpu.data.synthetic import SyntheticCTR
+    from parameter_server_tpu.parallel import lr_spmd
+
+    n_dev = len(jax.devices())
+    if n_dev % mesh_data:
+        raise ValueError(f"{n_dev} devices not divisible by data={mesh_data}")
+    mesh = distributed.global_mesh((mesh_data, n_dev // mesh_data))
+    cfg = TableConfig(
+        name="w",
+        rows=rows,
+        dim=1,
+        optimizer=OptimizerConfig(kind="adagrad", learning_rate=0.1),
+    )
+    trainer = lr_spmd.SpmdLRTrainer(cfg, mesh, seed=seed)
+    # every process generates the identical global stream; determinism of the
+    # data assignment is what lets a restarted/elastic process rejoin
+    data = SyntheticCTR(
+        key_space=4 * rows, nnz=nnz, batch_size=global_batch, seed=seed
+    )
+    # A process feeds the batch rows its own devices address.  When the data
+    # axis spans the processes (mesh_data >= num_procs) that is a contiguous
+    # 1/num_procs slice; when it doesn't (e.g. mesh_data=1: batch replicated
+    # along the model axis), every process addresses the full batch.
+    if mesh_data >= num_procs and mesh_data % num_procs == 0:
+        sl = distributed.local_batch_slice(proc_id, num_procs, global_batch)
+    else:
+        sl = slice(None)
+    losses = []
+    for _ in range(steps):
+        keys, labels = data.next_batch()
+        losses.append(
+            trainer.step(keys[sl], labels[sl], global_batch=global_batch)
+        )
+    return losses
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--coordinator", default=None)
+    p.add_argument("--num-procs", type=int, default=1)
+    p.add_argument("--proc-id", type=int, default=0)
+    p.add_argument("--cpu-devices", type=int, default=0)
+    p.add_argument("--steps", type=int, default=8)
+    p.add_argument("--rows", type=int, default=1 << 12)
+    p.add_argument("--global-batch", type=int, default=256)
+    p.add_argument("--nnz", type=int, default=8)
+    p.add_argument("--mesh-data", type=int, default=2)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--outdir", default=None)
+    args = p.parse_args(argv)
+    losses = run_job(
+        coordinator=args.coordinator,
+        num_procs=args.num_procs,
+        proc_id=args.proc_id,
+        cpu_devices=args.cpu_devices,
+        steps=args.steps,
+        rows=args.rows,
+        global_batch=args.global_batch,
+        nnz=args.nnz,
+        mesh_data=args.mesh_data,
+        seed=args.seed,
+    )
+    if args.outdir:
+        path = os.path.join(args.outdir, f"proc{args.proc_id}.json")
+        with open(path, "w") as f:
+            json.dump({"proc": args.proc_id, "losses": losses}, f)
+    return 0
+
+
+def launch_spmd(
+    *,
+    num_procs: int = 2,
+    cpu_devices: int = 4,
+    steps: int = 8,
+    rows: int = 1 << 12,
+    global_batch: int = 256,
+    nnz: int = 8,
+    mesh_data: int = 2,
+    seed: int = 0,
+    timeout: float = 300.0,
+    python: str = sys.executable,
+) -> dict:
+    """Spawn the CPU-sim pod: ``num_procs`` processes x ``cpu_devices``.
+
+    Returns ``{"returncodes": [...], "losses": {proc_id: [...]}}``.
+    """
+    port = _free_port()
+    outdir = tempfile.mkdtemp(prefix="psx_spmd_")
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    pypath = os.environ.get("PYTHONPATH", "")
+    env = dict(
+        os.environ,
+        PYTHONPATH=f"{repo_root}:{pypath}" if pypath else repo_root,
+    )
+
+    procs = [
+        subprocess.Popen(
+            [
+                python, "-m", "parameter_server_tpu.launch_spmd",
+                "--coordinator", f"127.0.0.1:{port}",
+                "--num-procs", str(num_procs),
+                "--proc-id", str(i),
+                "--cpu-devices", str(cpu_devices),
+                "--steps", str(steps), "--rows", str(rows),
+                "--global-batch", str(global_batch), "--nnz", str(nnz),
+                "--mesh-data", str(mesh_data), "--seed", str(seed),
+                "--outdir", outdir,
+            ],
+            env=env,
+        )
+        for i in range(num_procs)
+    ]
+    deadline = time.monotonic() + timeout
+    rcs = []
+    try:
+        for p_ in procs:
+            try:
+                rcs.append(
+                    p_.wait(timeout=max(deadline - time.monotonic(), 1.0))
+                )
+            except subprocess.TimeoutExpired:
+                # e.g. the coordinator died and a peer hangs in initialize:
+                # report which processes hung instead of raising, so callers
+                # see the real failing rc alongside the -9s
+                rcs.append(None)
+    finally:
+        for p_ in procs:
+            if p_.poll() is None:
+                p_.kill()
+    rcs = [p_.poll() if rc is None else rc for rc, p_ in zip(rcs, procs)]
+    losses = {}
+    for i in range(num_procs):
+        path = os.path.join(outdir, f"proc{i}.json")
+        if os.path.exists(path):
+            with open(path) as f:
+                losses[i] = json.load(f)["losses"]
+    shutil.rmtree(outdir, ignore_errors=True)
+    return {"returncodes": rcs, "losses": losses}
+
+
+if __name__ == "__main__":
+    sys.exit(main())

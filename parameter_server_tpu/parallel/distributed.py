@@ -1,0 +1,112 @@
+"""Multi-host runtime: jax.distributed init, global mesh, per-host data.
+
+The reference runs one OS process per node role and wires them with its Van
+(``script/local.sh`` + ``src/system/manager.h`` [U]); a TPU pod instead runs
+one process per *host*, each owning its local chips, coordinated by the JAX
+distributed service (gRPC).  This module is that runtime seam (SURVEY.md §7
+build-order step 4 — the piece VERDICT r1 flagged missing):
+
+- :func:`initialize` — process startup: ``jax.distributed.initialize``
+  against the coordinator, with a CPU-sim path (``cpu_devices=k`` forces k
+  virtual devices per process, so a v5e-16's 4-host topology is testable as
+  4 processes x 4 fake devices on one machine; collectives ride Gloo instead
+  of ICI, same program).
+- :func:`global_mesh` — the pod-wide Mesh over ALL processes' devices.
+  Axis layout puts the process (host/DCN) boundary on the leading axis so
+  model-axis collectives stay intra-host (ICI) — the scaling-book rule of
+  keeping the fast axis on the fast interconnect.
+- :func:`host_local_batch` — per-host input sharding: each process supplies
+  only its slice of the global batch (the reference's WorkloadPool file-shard
+  assignment, reborn as ``jax.make_array_from_process_local_data``).
+
+Single-process runs degrade gracefully: ``initialize`` is a no-op without a
+coordinator, and ``host_local_batch`` falls back to ``jax.device_put``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+def initialize(
+    coordinator: Optional[str] = None,
+    num_processes: int = 1,
+    process_id: int = 0,
+    *,
+    cpu_devices: int = 0,
+) -> None:
+    """Join the distributed job (no-op when single-process).
+
+    ``coordinator``: ``host:port`` of process 0's coordination service.
+    ``cpu_devices > 0``: CPU-sim mode — pin this process to ``cpu_devices``
+    virtual CPU devices (must run before any jax backend init).
+    """
+    if cpu_devices:
+        from parameter_server_tpu.utils.platform import force_cpu
+
+        force_cpu(cpu_devices)
+    if coordinator is None or num_processes <= 1:
+        return
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def global_mesh(
+    shape: Optional[Sequence[int]] = None,
+    axis_names: Sequence[str] = ("data", "model"),
+):
+    """Mesh over every device of every process in the job.
+
+    Default shape: ``(num_processes, local_device_count)`` for 2 axes — the
+    data axis crosses the host (DCN) boundary, the model axis stays on one
+    host's chips (ICI), so table-row collectives never leave the host.
+    """
+    import jax
+
+    from parameter_server_tpu.parallel import mesh as mesh_lib
+
+    devices = jax.devices()
+    if shape is None and len(axis_names) == 2:
+        shape = (jax.process_count(), len(devices) // jax.process_count())
+    return mesh_lib.make_mesh(shape, axis_names, devices=devices)
+
+
+def host_local_batch(sharding, local_data: np.ndarray,
+                     global_shape: Sequence[int]):
+    """Assemble a global array from this process's slice of the batch.
+
+    ``local_data`` is the rows this host read from ITS data shard (the
+    WorkloadPool assignment); the result is a global ``jax.Array`` sharded
+    per ``sharding`` whose addressable pieces come from ``local_data``.
+    Single-process jobs just device_put the (complete) data.
+    """
+    import jax
+
+    if jax.process_count() == 1:
+        return jax.device_put(local_data, sharding)
+    return jax.make_array_from_process_local_data(
+        sharding, local_data, tuple(global_shape)
+    )
+
+
+def local_batch_slice(process_id: int, num_processes: int,
+                      global_batch: int) -> slice:
+    """Contiguous rows of the global batch this process feeds.
+
+    Matches the data-axis device order of :func:`global_mesh` (process-major),
+    so a process's rows land on its own devices — no cross-host scatter.
+    """
+    if global_batch % num_processes:
+        raise ValueError(
+            f"global batch {global_batch} not divisible by {num_processes}"
+        )
+    per = global_batch // num_processes
+    return slice(process_id * per, (process_id + 1) * per)

@@ -21,7 +21,7 @@ trajectories agree to float tolerance — tested on the 8-device CPU mesh).
 from __future__ import annotations
 
 import functools
-from typing import Dict, NamedTuple
+from typing import Dict, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -65,28 +65,33 @@ class SpmdLRTrainer:
 
         t_shard = mesh_lib.table_sharding(mesh)
         r_shard = mesh_lib.replicated(mesh)
-        self.state = ShardedLRState(
-            value=jax.device_put(
-                jnp.zeros((self.total_rows, 1), jnp.float32), t_shard
-            ),
-            state={
-                k: jax.device_put(
-                    jnp.full((self.total_rows, 1), fill, jnp.float32), t_shard
-                )
-                for k, fill in self.optimizer.state_shapes().items()
-            },
-            bias=jax.device_put(jnp.zeros((1, 1), jnp.float32), r_shard),
-            bias_state={
-                k: jax.device_put(jnp.zeros((1, 1), jnp.float32), r_shard)
-                for k in self.optimizer.state_shapes()
-            },
-        )
         state_shardings = ShardedLRState(
             value=t_shard,
             state={k: t_shard for k in self.optimizer.state_shapes()},
             bias=r_shard,
             bias_state={k: r_shard for k in self.optimizer.state_shapes()},
         )
+
+        # Initialize INSIDE jit with out_shardings (not host device_put):
+        # each shard materializes directly on its device — no host round-trip
+        # for the table, and it works when the mesh spans multiple processes
+        # (a pod), where no single process could device_put the global array.
+        def init_fn() -> ShardedLRState:
+            return ShardedLRState(
+                value=jnp.zeros((self.total_rows, 1), jnp.float32),
+                state={
+                    k: jnp.full((self.total_rows, 1), fill, jnp.float32)
+                    for k, fill in self.optimizer.state_shapes().items()
+                },
+                bias=jnp.zeros((1, 1), jnp.float32),
+                bias_state={
+                    k: jnp.zeros((1, 1), jnp.float32)
+                    for k in self.optimizer.state_shapes()
+                },
+            )
+
+        with mesh:
+            self.state = jax.jit(init_fn, out_shardings=state_shardings)()
         batch2 = mesh_lib.batch_sharding(mesh, 2)
         batch1 = mesh_lib.batch_sharding(mesh, 1)
 
@@ -113,16 +118,47 @@ class SpmdLRTrainer:
         )
         self._batch2, self._batch1 = batch2, batch1
 
-    def place_batch(self, keys: np.ndarray, labels: np.ndarray):
-        """Hash keys to slots on host and shard the batch over the mesh."""
-        slots_pos = self.localizer.assign(keys)
+    def place_batch(
+        self,
+        keys: np.ndarray,
+        labels: np.ndarray,
+        *,
+        global_batch: Optional[int] = None,
+    ):
+        """Hash keys to slots on host and shard the batch over the mesh.
+
+        ``keys``/``labels`` are THIS process's slice of the global batch
+        (the whole batch when single-process): each pod host hashes and
+        stages only the rows its own devices consume — the WorkloadPool
+        data-shard assignment, with no cross-host batch scatter.
+
+        ``global_batch``: total rows across all processes.  Defaults to
+        ``local * process_count`` (an even data-axis split over processes);
+        pass it explicitly when the data axis does not cross the process
+        boundary (each process then feeds the full batch).
+        """
+        from parameter_server_tpu.parallel import distributed
+
+        slots_pos = np.asarray(self.localizer.assign(keys))
+        labels = np.asarray(labels)
+        gb = global_batch or labels.shape[0] * jax.process_count()
         return (
-            jax.device_put(jnp.asarray(slots_pos), self._batch2),
-            jax.device_put(jnp.asarray(labels), self._batch1),
+            distributed.host_local_batch(
+                self._batch2, slots_pos, (gb, slots_pos.shape[1])
+            ),
+            distributed.host_local_batch(self._batch1, labels, (gb,)),
         )
 
-    def step(self, keys: np.ndarray, labels: np.ndarray) -> float:
-        slots, labels_d = self.place_batch(keys, labels)
+    def step(
+        self,
+        keys: np.ndarray,
+        labels: np.ndarray,
+        *,
+        global_batch: Optional[int] = None,
+    ) -> float:
+        slots, labels_d = self.place_batch(
+            keys, labels, global_batch=global_batch
+        )
         self.state, loss = self._step(self.state, slots, labels_d)
         return float(loss)
 

@@ -1,0 +1,91 @@
+"""Multi-host runtime: jax.distributed pod sim (VERDICT r1 missing #2).
+
+The CPU-sim pod — N OS processes x K virtual CPU devices joined by
+``jax.distributed`` into one global mesh — must train the GSPMD sparse-LR
+path to the SAME losses as a single process over the identical mesh shape.
+That equality is the whole point: the program is mesh-shape-defined, the
+process topology is deployment detail (SURVEY.md §7 step 4).
+"""
+
+import numpy as np
+import pytest
+
+from parameter_server_tpu.launch_spmd import launch_spmd, run_job
+from parameter_server_tpu.parallel import distributed
+
+STEPS = 6
+ROWS = 1 << 12
+GLOBAL_BATCH = 256
+
+
+def _single_process_losses():
+    # in-process: conftest already pinned 8 virtual CPU devices
+    return run_job(
+        coordinator=None,
+        num_procs=1,
+        proc_id=0,
+        cpu_devices=0,
+        steps=STEPS,
+        rows=ROWS,
+        global_batch=GLOBAL_BATCH,
+        nnz=8,
+        mesh_data=2,
+        seed=0,
+    )
+
+
+def test_local_batch_slice():
+    sl0 = distributed.local_batch_slice(0, 4, 256)
+    sl3 = distributed.local_batch_slice(3, 4, 256)
+    assert (sl0.start, sl0.stop) == (0, 64)
+    assert (sl3.start, sl3.stop) == (192, 256)
+    with pytest.raises(ValueError):
+        distributed.local_batch_slice(0, 3, 256)
+
+
+def test_multiprocess_matches_single_process_losses():
+    single = _single_process_losses()
+    assert single[-1] < single[0]  # it actually trains
+
+    result = launch_spmd(
+        num_procs=2,
+        cpu_devices=4,
+        steps=STEPS,
+        rows=ROWS,
+        global_batch=GLOBAL_BATCH,
+        nnz=8,
+        mesh_data=2,
+        seed=0,
+        timeout=240.0,
+    )
+    assert result["returncodes"] == [0, 0], result
+    assert sorted(result["losses"]) == [0, 1]
+    # every process reports the same (global, replicated) trajectory
+    np.testing.assert_allclose(
+        result["losses"][0], result["losses"][1], rtol=1e-6
+    )
+    # and it matches the single-process run over the same (2, 4) mesh
+    np.testing.assert_allclose(
+        result["losses"][0], single, rtol=1e-4, atol=1e-6
+    )
+
+
+def test_multiprocess_rows_sharded_across_hosts():
+    """mesh_data=1 -> the model (table-row) axis spans BOTH processes: table
+    shards live on different hosts, gather/update collectives cross the
+    process (DCN) boundary — the pod analogue of cross-host server ranges."""
+    result = launch_spmd(
+        num_procs=2,
+        cpu_devices=4,
+        steps=4,
+        rows=1 << 12,
+        global_batch=GLOBAL_BATCH,
+        nnz=8,
+        mesh_data=1,
+        seed=0,
+        timeout=240.0,
+    )
+    assert result["returncodes"] == [0, 0], result
+    losses = result["losses"][0]
+    assert np.all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
