@@ -114,3 +114,41 @@ def test_countmin_filter():
     cm.add(np.array([42] * 10 + [7], dtype=np.uint64))
     mask = cm.filter(np.array([42, 7, 99], dtype=np.uint64), threshold=5)
     assert mask.tolist() == [True, False, False]
+
+
+def test_localizer_engines_agree(monkeypatch):
+    """Native C++ keymap and the numpy fallback produce identical slot
+    streams — sequential ids, overflow hashing, PAD, duplicates sharing a
+    slot, and table growth/rehash (vocab crosses both engines' initial
+    1<<16 table at load factor 1/2)."""
+    from parameter_server_tpu.utils import keys as keys_mod
+
+    native = Localizer(capacity=50_000)
+    if native._native is None:  # pragma: no cover — toolchain-less host
+        pytest.skip("no native toolchain")
+    # real constructor, numpy engine (native.load caches per process, so
+    # PS_NO_NATIVE can't flip it here — stub the factory instead)
+    monkeypatch.setattr(keys_mod, "_native_keymap", lambda cap: None)
+    fallback = Localizer(capacity=50_000)
+    assert fallback._native is None
+
+    rng = np.random.default_rng(3)
+    for i in range(20):
+        n = int(rng.integers(1, 4000))
+        batch = np.unique(rng.integers(0, 2**62, size=n).astype(np.uint64))
+        if i % 3 == 0:
+            batch = np.concatenate([batch, [PAD_KEY]])
+        if i % 4 == 0 and batch.size > 2:  # duplicates share one slot
+            batch = np.concatenate([batch, batch[:2]])
+        np.testing.assert_array_equal(
+            native.assign(batch), fallback.assign(batch)
+        )
+    assert len(native) == len(fallback) > (1 << 16) // 2  # growth exercised
+    assert native.overflowed == fallback.overflowed
+
+
+def test_localizer_duplicate_new_keys_share_slot():
+    loc = Localizer(capacity=100)
+    out = loc.assign(np.array([5, 5, 7], dtype=np.uint64))
+    assert out.tolist() == [0, 0, 1]
+    assert len(loc) == 2
