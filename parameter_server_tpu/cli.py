@@ -159,6 +159,16 @@ def _cmd_launch(args: argparse.Namespace) -> int:
 
 
 def main(argv=None) -> int:
+    # The dev image's sitecustomize registers the axon TPU plugin at
+    # interpreter boot, BEFORE the environment's JAX_PLATFORMS=cpu is
+    # consulted — re-assert the caller's intent or a CPU-only run hangs on
+    # TPU backend init (same trick as tests/conftest.py / __graft_entry__).
+    import os
+
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        from parameter_server_tpu.utils.platform import force_cpu
+
+        force_cpu()
     args = build_parser().parse_args(argv)
     return args.fn(args)
 

@@ -1,0 +1,169 @@
+"""Hybrid LM trainer: PS-served embeddings + GSPMD-synchronous transformer.
+
+BASELINE config #5 as specified ("Llama-3 8B hybrid PS-embeddings + XLA
+allreduce transformer", SURVEY.md §7 step 7; the composition VERDICT r1
+flagged missing): ONE training step combines both planes —
+
+- **embedding rows ride the Van**: pulled from / pushed to a
+  :class:`~parameter_server_tpu.kv.server.KVServer` through
+  :class:`~parameter_server_tpu.kv.worker.KVWorker` (async timestamps,
+  filter-capable, DCN-routable, elastic) with an
+  :class:`~parameter_server_tpu.utils.keys.IdentityLocalizer` so token id ==
+  table row (the reference's key-range partition over the vocabulary);
+- **the dense body is synchronous GSPMD**: batch sharded over the mesh's
+  ``data`` axis, params TP-sharded per ``parallel/tp.py``; XLA inserts the
+  gradient allreduce (the "NCCL allreduce" half of the config).
+
+Why this split scales: the embedding table is the memory giant (Llama-3 8B:
+128k x 4096 x 4 B = 2.1 GB plus optimizer rows — and DLRM-class tables are
+100x that) with *sparse* per-step access (only the batch's unique tokens),
+exactly the PS access pattern; the body is dense compute, exactly the GSPMD
+pattern.  Serving rows from PS also admits staleness: pushes are not waited
+on individually but bounded by a delay window τ (SSP; τ=0 = BSP), so
+embedding traffic overlaps body compute — the reference's bounded-delay
+pipelining (``Task.wait_time``) applied to the embedding plane.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from parameter_server_tpu.config import OptimizerConfig, TableConfig
+from parameter_server_tpu.kv.worker import KVWorker
+from parameter_server_tpu.models import transformer as tfm
+from parameter_server_tpu.parallel import mesh as mesh_lib
+from parameter_server_tpu.parallel.tp import place_params
+from parameter_server_tpu.utils import metrics as metrics_lib
+from parameter_server_tpu.utils.keys import IdentityLocalizer
+
+
+def embedding_table_cfg(
+    cfg: tfm.TransformerConfig,
+    *,
+    learning_rate: float = 0.05,
+    optimizer: str = "adagrad",
+) -> TableConfig:
+    """KV table config for the PS-served embedding: row per token id."""
+    return TableConfig(
+        name="emb",
+        rows=cfg.vocab_size,
+        dim=cfg.d_model,
+        optimizer=OptimizerConfig(kind=optimizer, learning_rate=learning_rate),
+        init_scale=0.02,  # normal(0.02) rows, matching the dense init
+    )
+
+
+def embedding_localizers(cfg: tfm.TransformerConfig) -> Dict[str, object]:
+    """Localizer map for :class:`KVWorker`: identity (token id == row)."""
+    return {"emb": IdentityLocalizer(cfg.vocab_size)}
+
+
+class HybridLMTrainer:
+    """One step = Van pull (rows) -> GSPMD body fwd/bwd -> Van push (grads).
+
+    ``max_delay``: how many embedding pushes may be in flight before the
+    next step blocks on the oldest ack (τ of SSP; 0 = BSP, every push
+    waited before the next pull).
+    """
+
+    def __init__(
+        self,
+        cfg: tfm.TransformerConfig,
+        mesh,
+        worker: KVWorker,
+        *,
+        table: str = "emb",
+        learning_rate: float = 1e-3,
+        max_delay: int = 0,
+        seed: int = 0,
+        dashboard: Optional[metrics_lib.Dashboard] = None,
+        push_timeout: float = 60.0,
+    ) -> None:
+        if cfg.tie_embeddings:
+            raise ValueError(
+                "hybrid requires untied embeddings: the lm_head is dense "
+                "(GSPMD), the input table is PS-served"
+            )
+        self.cfg = cfg
+        self.mesh = mesh
+        self.worker = worker
+        self.table = table
+        self.max_delay = max_delay
+        self.push_timeout = push_timeout
+        self.dashboard = dashboard or metrics_lib.Dashboard(print_every=0)
+        self.body = tfm.TransformerBody(cfg)
+        self.tx = optax.adamw(learning_rate)
+        x0 = jnp.zeros((1, 8, cfg.d_model), jnp.float32)
+        params = self.body.init(jax.random.PRNGKey(seed), x0)["params"]
+        self.params = place_params(params, mesh)
+        self.opt_state = self.tx.init(self.params)
+        self._batch3 = mesh_lib.batch_sharding(mesh, 3)
+        self._batch2 = mesh_lib.batch_sharding(mesh, 2)
+        self._inflight: collections.deque[int] = collections.deque()
+        self.step_count = 0
+        body, tx = self.body, self.tx
+
+        def loss_fn(params, emb_in, targets):
+            logits = body.apply({"params": params}, emb_in)
+            return tfm.causal_lm_loss(logits, targets)
+
+        def step_fn(params, opt_state, emb_in, targets):
+            # grads w.r.t. (params, emb_in): the emb_in gradient is what
+            # flows back to the PS table as per-position row updates
+            (loss, grads) = jax.value_and_grad(loss_fn, argnums=(0, 1))(
+                params, emb_in, targets
+            )
+            g_params, g_emb = grads
+            updates, opt_state = tx.update(g_params, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss, g_emb
+
+        self._step = jax.jit(step_fn, donate_argnums=(0, 1))
+
+    # -- the hybrid hot path -------------------------------------------------
+    def step(self, tokens: np.ndarray, *, pull_timeout: float = 60.0) -> float:
+        """tokens [B, S] -> loss.  Van pull + GSPMD step + Van push."""
+        tokens = np.asarray(tokens)
+        # 1) PS plane: pull this batch's embedding rows over the Van
+        emb_in = self.worker.pull_sync(self.table, tokens, timeout=pull_timeout)
+        emb_d = jax.device_put(jnp.asarray(emb_in, jnp.float32), self._batch3)
+        tok_d = jax.device_put(jnp.asarray(tokens, jnp.int32), self._batch2)
+        # 2) dense plane: synchronous GSPMD body step (XLA allreduce)
+        self.params, self.opt_state, loss, g_emb = self._step(
+            self.params, self.opt_state, emb_d, tok_d
+        )
+        # 3) PS plane: push per-position embedding gradients (server-side
+        # optimizer applies them); bounded-delay, not per-push blocking
+        g = np.asarray(g_emb).reshape(-1, self.cfg.d_model)
+        ts = self.worker.push(self.table, tokens.reshape(-1), g)
+        self._inflight.append(ts)
+        while len(self._inflight) > self.max_delay:
+            old = self._inflight.popleft()
+            if not self.worker.wait(old, timeout=self.push_timeout):
+                raise TimeoutError(f"embedding push ts={old} not acked")
+        self.step_count += 1
+        loss_f = float(loss)
+        self.dashboard.record(self.step_count, loss_f, examples=tokens.shape[0])
+        return loss_f
+
+    def drain(self) -> None:
+        """Block until every in-flight embedding push is acked (epoch end)."""
+        while self._inflight:
+            old = self._inflight.popleft()
+            if not self.worker.wait(old, timeout=self.push_timeout):
+                raise TimeoutError(f"embedding push ts={old} not acked")
+
+    def logits(self, tokens: np.ndarray, *, pull_timeout: float = 60.0):
+        tokens = np.asarray(tokens)
+        emb_in = self.worker.pull_sync(self.table, tokens, timeout=pull_timeout)
+        return np.asarray(
+            self.body.apply(
+                {"params": self.params}, jnp.asarray(emb_in, jnp.float32)
+            )
+        )

@@ -179,6 +179,29 @@ class Block(nn.Module):
         return x + MLPBlock(cfg, name="mlp")(h)
 
 
+def _apply_body(mod: nn.Module, cfg: TransformerConfig, x, attn_mask):
+    """Shared block stack: pos-emb + layers + final norm (no head).
+
+    Called from inside a module's ``@nn.compact`` ``__call__``; submodules
+    and params attach to the CALLER's scope with identical names, so
+    :class:`Transformer` and :class:`TransformerBody` stay one
+    implementation with interchangeable param trees.
+    """
+    B, S, _ = x.shape
+    x = x.astype(cfg.dtype)
+    positions = jnp.broadcast_to(jnp.arange(S)[None], (B, S))
+    if cfg.positional == "learned":
+        pos_emb = mod.param(
+            "pos_embedding",
+            nn.initializers.normal(0.02),
+            (cfg.max_seq, cfg.d_model),
+        )
+        x = x + pos_emb[None, :S].astype(cfg.dtype)
+    for i in range(cfg.n_layers):
+        x = Block(cfg, name=f"layer_{i}")(x, positions, attn_mask)
+    return Norm(cfg.norm, cfg.dtype, name="final_norm")(x)
+
+
 class Transformer(nn.Module):
     cfg: TransformerConfig
 
@@ -191,19 +214,7 @@ class Transformer(nn.Module):
             nn.initializers.normal(0.02),
             (cfg.vocab_size, cfg.d_model),
         )
-        x = emb[tokens].astype(cfg.dtype)
-        B, S = tokens.shape
-        positions = jnp.broadcast_to(jnp.arange(S)[None], (B, S))
-        if cfg.positional == "learned":
-            pos_emb = self.param(
-                "pos_embedding",
-                nn.initializers.normal(0.02),
-                (cfg.max_seq, cfg.d_model),
-            )
-            x = x + pos_emb[None, :S].astype(cfg.dtype)
-        for i in range(cfg.n_layers):
-            x = Block(cfg, name=f"layer_{i}")(x, positions, attn_mask)
-        x = Norm(cfg.norm, cfg.dtype, name="final_norm")(x)
+        x = _apply_body(self, cfg, emb[tokens], attn_mask)
         if cfg.tie_embeddings:
             logits = jnp.einsum(
                 "bsd,vd->bsv", x, emb.astype(cfg.dtype),
@@ -214,6 +225,30 @@ class Transformer(nn.Module):
                 cfg.vocab_size, use_bias=False, name="lm_head",
                 dtype=cfg.dtype,
             )(x)
+        return logits.astype(jnp.float32)
+
+
+class TransformerBody(nn.Module):
+    """The dense half of the PS hybrid (BASELINE config #5): blocks + final
+    norm + untied lm_head, taking PRE-COMPUTED input embeddings.
+
+    The embedding table itself lives in a KVServer (async Push/Pull over the
+    Van, row-partitioned by token id — the reference's key-range scheme),
+    while this body trains synchronously under GSPMD: batch sharded over
+    ``data``, params TP-sharded per ``parallel/tp.py``, XLA emitting the
+    allreduce.  ``learner/hybrid.py`` glues the two halves.
+    """
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, attn_mask=None):
+        """x [B, S, d_model] input embeddings -> logits [B, S, vocab]."""
+        cfg = self.cfg
+        x = _apply_body(self, cfg, x, attn_mask)
+        logits = nn.Dense(
+            cfg.vocab_size, use_bias=False, name="lm_head", dtype=cfg.dtype
+        )(x)
         return logits.astype(jnp.float32)
 
 

@@ -216,6 +216,36 @@ class HashLocalizer:
         return np.where(keys == PAD_KEY, np.int32(self.capacity), slots)
 
 
+class IdentityLocalizer:
+    """Exact key == row-slot mapping for dense-vocabulary tables.
+
+    Embedding tables (token id -> row) need every id to hit ITS OWN row —
+    hashing would collide distinct tokens.  Keys must already be dense ids
+    in ``[0, capacity)``; PAD_KEY maps to the trash row ``capacity``.
+    """
+
+    def __init__(self, capacity: int):
+        if not (0 < capacity < 2**31 - 1):
+            raise ValueError("capacity must fit int32 row ids")
+        self.capacity = capacity
+        self.overflowed = False
+
+    def assign(self, unique_keys: np.ndarray) -> np.ndarray:
+        keys = np.asarray(unique_keys, dtype=np.uint64)
+        is_pad = keys == PAD_KEY
+        # only PAD may reach the trash row (== capacity); a real key equal to
+        # capacity must error, not silently alias pad updates
+        bad = ~is_pad & (keys >= np.uint64(self.capacity))
+        if bad.any():
+            raise ValueError(
+                f"IdentityLocalizer: key {int(keys[bad][0])} outside [0, "
+                f"{self.capacity}) (dense-vocab tables take raw ids)"
+            )
+        return np.where(
+            is_pad, np.int64(self.capacity), keys.astype(np.int64)
+        ).astype(np.int32)
+
+
 class _NativeKeyMap:
     """ctypes wrapper around the C++ keymap (``native/src/keymap.cc``)."""
 

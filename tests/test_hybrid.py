@@ -1,0 +1,125 @@
+"""Hybrid LM trainer (BASELINE config #5): PS embeddings + GSPMD body.
+
+The composition test VERDICT r1 asked for: ONE training step where the
+embedding rows travel as Van PUSH/PULL traffic through a real
+KVWorker/KVServer topology while the dense transformer body trains
+synchronously under GSPMD (XLA-inserted allreduce on the data axis), with
+loss decreasing.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from parameter_server_tpu.core.postoffice import Postoffice
+from parameter_server_tpu.core.van import LoopbackVan
+from parameter_server_tpu.kv.server import KVServer
+from parameter_server_tpu.kv.worker import KVWorker
+from parameter_server_tpu.learner import hybrid
+from parameter_server_tpu.models import transformer as tfm
+from parameter_server_tpu.parallel import mesh as mesh_lib
+from parameter_server_tpu.utils.keys import IdentityLocalizer
+
+NUM_SERVERS = 2
+
+
+@pytest.fixture
+def cluster():
+    van = LoopbackVan()
+    cfg = tfm.tiny_config(causal=True, tie_embeddings=False)
+    table_cfgs = {"emb": hybrid.embedding_table_cfg(cfg, learning_rate=0.1)}
+    servers = []
+    for s in range(NUM_SERVERS):
+        post = Postoffice(f"S{s}", van)
+        servers.append(KVServer(post, table_cfgs, s, NUM_SERVERS))
+    wpost = Postoffice("W0", van)
+    worker = KVWorker(
+        wpost,
+        table_cfgs,
+        NUM_SERVERS,
+        localizers=hybrid.embedding_localizers(cfg),
+    )
+    try:
+        yield cfg, van, servers, worker
+    finally:
+        van.close()
+
+
+def _tokens(cfg, rng, batch=8, seq=16):
+    # structured stream (periodic patterns) so a tiny model can learn it
+    base = rng.integers(0, cfg.vocab_size, size=(batch, 1))
+    offs = np.arange(seq)[None, :]
+    return ((base + offs) % cfg.vocab_size).astype(np.int32)
+
+
+def test_hybrid_trains_and_routes_embeddings_via_van(cluster):
+    cfg, van, servers, worker = cluster
+    mesh = mesh_lib.make_mesh((4, 2))
+    trainer = hybrid.HybridLMTrainer(
+        cfg, mesh, worker, learning_rate=3e-3, max_delay=0
+    )
+    rng = np.random.default_rng(0)
+    losses = [trainer.step(_tokens(cfg, rng)) for _ in range(12)]
+    trainer.drain()
+    assert np.all(np.isfinite(losses))
+    assert np.mean(losses[-3:]) < np.mean(losses[:3]), losses
+    # embedding traffic went through the Van to BOTH range shards
+    assert all(s.pushes > 0 and s.pulls > 0 for s in servers)
+    assert van.sent_messages > 0
+    # and the PS table actually learned (moved off its init)
+    t0 = servers[0].tables["emb"]
+    assert float(np.abs(np.asarray(t0.state["sum_sq"][:-1])).sum()) > 0
+
+
+def test_hybrid_body_step_contains_allreduce(cluster):
+    """The dense half really is sync-GSPMD: the compiled step carries an
+    all-reduce over the data axis (the config's 'XLA allreduce')."""
+    cfg, van, servers, worker = cluster
+    mesh = mesh_lib.make_mesh((4, 2))
+    trainer = hybrid.HybridLMTrainer(cfg, mesh, worker, max_delay=0)
+    rng = np.random.default_rng(1)
+    tokens = _tokens(cfg, rng)
+    import jax.numpy as jnp
+
+    emb = worker.pull_sync("emb", tokens, timeout=30)
+    lowered = trainer._step.lower(
+        trainer.params,
+        trainer.opt_state,
+        jax.device_put(jnp.asarray(emb, jnp.float32), trainer._batch3),
+        jax.device_put(jnp.asarray(tokens, jnp.int32), trainer._batch2),
+    )
+    hlo = lowered.compile().as_text()
+    assert "all-reduce" in hlo
+
+
+def test_hybrid_ssp_bounded_delay(cluster):
+    """max_delay=tau keeps at most tau embedding pushes un-acked (SSP)."""
+    cfg, van, servers, worker = cluster
+    mesh = mesh_lib.make_mesh((4, 2))
+    trainer = hybrid.HybridLMTrainer(
+        cfg, mesh, worker, learning_rate=3e-3, max_delay=3
+    )
+    rng = np.random.default_rng(2)
+    losses = [trainer.step(_tokens(cfg, rng)) for _ in range(10)]
+    assert len(trainer._inflight) <= 3
+    trainer.drain()
+    assert not trainer._inflight
+    assert np.all(np.isfinite(losses))
+    assert np.mean(losses[-3:]) < np.mean(losses[:3]), losses
+
+
+def test_hybrid_rejects_tied_embeddings():
+    cfg = tfm.tiny_config(causal=True, tie_embeddings=True)
+    with pytest.raises(ValueError, match="untied"):
+        hybrid.HybridLMTrainer(cfg, mesh_lib.make_mesh((2, 4)), worker=None)
+
+
+def test_identity_localizer_contract():
+    loc = IdentityLocalizer(100)
+    from parameter_server_tpu.utils.keys import PAD_KEY
+
+    out = loc.assign(np.array([0, 5, 99, PAD_KEY], dtype=np.uint64))
+    assert out.tolist() == [0, 5, 99, 100]
+    with pytest.raises(ValueError, match="outside"):
+        loc.assign(np.array([150], dtype=np.uint64))
