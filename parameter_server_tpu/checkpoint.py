@@ -287,8 +287,14 @@ def load_global_arrays(root: str, step: int, table_name: str) -> Dict[str, np.nd
 
 
 def retain(root: str, keep: int) -> None:
-    """Delete all but the newest ``keep`` committed checkpoints."""
+    """Delete all but the newest ``keep`` committed checkpoints.
+
+    ``keep=0`` deletes every committed checkpoint; negative is an error.
+    """
     import shutil
 
-    for step in list_steps(root)[:-keep] if keep > 0 else []:
+    if keep < 0:
+        raise ValueError(f"retain: keep must be >= 0, got {keep}")
+    steps = list_steps(root)
+    for step in steps if keep == 0 else steps[:-keep]:
         shutil.rmtree(_step_dir(root, step), ignore_errors=True)

@@ -56,7 +56,12 @@ def _cmd_eval(args: argparse.Namespace) -> int:
         batches,
         step=args.step,
         model=args.model,
-        localizer=HashLocalizer(args.rows) if args.rows else None,
+        localizer=(
+            HashLocalizer(args.rows, hash_bits=args.hash_bits or 64)
+            if args.rows
+            else None
+        ),
+        hash_bits=args.hash_bits or None,
     )
     print(json.dumps(report))
     return 0
@@ -85,6 +90,11 @@ def build_parser() -> argparse.ArgumentParser:
     ev.add_argument("--model", default="lr", choices=["lr", "fm"])
     ev.add_argument("--step", type=int, default=None)
     ev.add_argument("--rows", type=int, default=0, help="localizer capacity")
+    ev.add_argument(
+        "--hash-bits", type=int, default=0, choices=[0, 32, 64],
+        help="hash width of the training localizer (0 = manifest/default); "
+        "device-hash tables need 32",
+    )
     ev.add_argument("--batches", type=int, default=8)
     ev.add_argument("--batch-size", type=int, default=1024)
     ev.add_argument("--key-space", type=int, default=1 << 22)

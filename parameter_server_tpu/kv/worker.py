@@ -180,12 +180,20 @@ class KVWorker(Customer):
         partial checkpoint.
         """
         from parameter_server_tpu import checkpoint
+        from parameter_server_tpu.utils.keys import localizer_meta
 
         ts = self._broadcast_control("save_model", {"root": root, "step": step})
         if not self.wait(ts, timeout):
             raise TimeoutError("save_model timed out")
         self.check(ts)
         self.take_responses(ts)
+        # Record each table's key->row mapping so offline eval reconstructs
+        # the exact localizer (hash_bits/seed) instead of guessing a default.
+        extras = dict(extras or {})
+        extras.setdefault(
+            "localizers",
+            {t: localizer_meta(loc) for t, loc in self.localizers.items()},
+        )
         checkpoint.finalize(
             root,
             step,

@@ -190,6 +190,15 @@ def launch_spmd(
         for p_ in procs:
             if p_.poll() is None:
                 p_.kill()
+        for p_ in procs:
+            # reap: SIGKILL delivery is asynchronous, so an immediate poll()
+            # can still read None — wait bounds it and makes the reported
+            # returncode deterministically -9 (ADVICE r2)
+            if p_.poll() is None:
+                try:
+                    p_.wait(timeout=5.0)
+                except subprocess.TimeoutExpired:
+                    pass  # unkillable (D-state): leave rc as None
     rcs = [p_.poll() if rc is None else rc for rc, p_ in zip(rcs, procs)]
     losses = {}
     for i in range(num_procs):

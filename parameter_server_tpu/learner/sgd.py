@@ -163,6 +163,10 @@ class LocalLRTrainer:
         ``keys_block``: ``[K, B, nnz]`` keys (must fit uint32);
         ``labels_block``: ``[K, B]``.  Returns the device losses ``[K]``
         without host sync — the block analogue of :meth:`step_async`.
+
+        Pass keys at their RAW width: the out-of-range guard below only runs
+        on non-uint32 input, so a caller-side ``astype(np.uint32)`` silently
+        wraps bad keys before the check can see them (ADVICE r2).
         """
         if not self.device_hash:
             raise ValueError("step_block requires device_hash=True")

@@ -45,20 +45,43 @@ struct KeyMap {
   uint64_t* keys = nullptr;
   int32_t* vals = nullptr;
   bool overflowed = false;
+  bool grow_failed = false;  // OOM latch: stop re-attempting huge mallocs
 
-  void alloc(uint64_t new_size) {
+  bool alloc(uint64_t new_size) {
+    uint64_t* new_keys =
+        static_cast<uint64_t*>(malloc(new_size * sizeof(uint64_t)));
+    int32_t* new_vals =
+        static_cast<int32_t*>(malloc(new_size * sizeof(int32_t)));
+    if (!new_keys || !new_vals) {  // OOM must not leave dangling pointers
+      free(new_keys);
+      free(new_vals);
+      return false;
+    }
     size = new_size;
     mask = new_size - 1;
-    keys = static_cast<uint64_t*>(malloc(new_size * sizeof(uint64_t)));
-    vals = static_cast<int32_t*>(malloc(new_size * sizeof(int32_t)));
+    keys = new_keys;
+    vals = new_vals;
     memset(keys, 0xFF, new_size * sizeof(uint64_t));  // all kEmpty
+    return true;
   }
 
-  void grow() {
+  bool grow() {
     uint64_t old_size = size;
     uint64_t* old_keys = keys;
     int32_t* old_vals = vals;
-    alloc(size * 2);
+    if (!alloc(size * 2)) {
+      // OOM: keep the old table intact.  The map still works — inserts
+      // continue until the table is literally full; assign_one falls back
+      // to feature hashing at capacity, so correctness is preserved.  The
+      // latch stops every later insert from re-attempting the same
+      // multi-hundred-MB malloc pair under memory pressure.
+      keys = old_keys;
+      vals = old_vals;
+      size = old_size;
+      mask = old_size - 1;
+      grow_failed = true;
+      return false;
+    }
     for (uint64_t i = 0; i < old_size; ++i) {
       if (old_keys[i] == kEmpty) continue;
       uint64_t p = mix64(old_keys[i]) & mask;
@@ -68,23 +91,28 @@ struct KeyMap {
     }
     free(old_keys);
     free(old_vals);
+    return true;
   }
 
   // find-or-insert one key; returns its slot
   inline int32_t assign_one(uint64_t k) {
     uint64_t p = mix64(k) & mask;
-    while (true) {
+    // Bounded probe: after grow()-OOM the load factor may exceed 1/2, and a
+    // literally full table would otherwise spin forever on an absent key.
+    for (uint64_t probes = 0; probes < size; ++probes) {
       uint64_t cur = keys[p];
       if (cur == k) return vals[p];
-      if (cur == kEmpty) break;
+      if (cur == kEmpty) {
+        if (n < capacity) {
+          int32_t slot = static_cast<int32_t>(n++);
+          keys[p] = k;
+          vals[p] = slot;
+          if (static_cast<uint64_t>(n) * 2 > size && !grow_failed) grow();
+          return slot;
+        }
+        break;
+      }
       p = (p + 1) & mask;
-    }
-    if (n < capacity) {
-      int32_t slot = static_cast<int32_t>(n++);
-      keys[p] = k;
-      vals[p] = slot;
-      if (static_cast<uint64_t>(n) * 2 > size) grow();
-      return slot;
     }
     overflowed = true;
     return static_cast<int32_t>(k % static_cast<uint64_t>(capacity));
@@ -99,7 +127,10 @@ void* ps_keymap_new(int64_t capacity) {
   if (capacity <= 0) return nullptr;
   auto* m = new KeyMap();
   m->capacity = capacity;
-  m->alloc(1 << 16);
+  if (!m->alloc(1 << 16)) {  // OOM -> nullptr; Python raises MemoryError
+    delete m;
+    return nullptr;
+  }
   return m;
 }
 
