@@ -105,3 +105,9 @@ class TableConfig:
     #: if True the table is sharded over the mesh "model" axis (row-wise,
     #: contiguous ranges — the NodeAssigner scheme); if False it is replicated.
     sharded: bool = True
+    #: row gather/scatter kernel on the Push/Pull hot path: "auto"/"xla"
+    #: (take / at[].set — measured at the HBM roofline on v5e, the default
+    #: verdict of bench.py --micro), or "pallas" (DMA kernels,
+    #: ops/scatter.py — interpreter-run off TPU so tests exercise the same
+    #: code path; dim == 128 or dim % 1024 == 0).
+    scatter_impl: str = "auto"

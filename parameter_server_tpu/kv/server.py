@@ -13,6 +13,7 @@ from __future__ import annotations
 
 from typing import Dict
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -21,7 +22,13 @@ from parameter_server_tpu.core.messages import Message, TaskKind
 from parameter_server_tpu.core.postoffice import Customer, Postoffice
 from parameter_server_tpu.kv.partition import RangePartition
 from parameter_server_tpu.kv.table import KVTable
+from parameter_server_tpu.utils.keys import bucket_size
 from parameter_server_tpu.utils.trace import NULL_TRACER, Tracer
+
+
+def _bucket(n: int) -> int:
+    """Server-side id bucket: next power of two, >= 8 (pallas block floor)."""
+    return bucket_size(max(n, 1), min_bucket=8)
 
 
 class KVServer(Customer):
@@ -36,8 +43,13 @@ class KVServer(Customer):
         *,
         name: str = "kv",
         tracer: Tracer = NULL_TRACER,
+        device_replies: bool = False,
     ) -> None:
         super().__init__(name, post)
+        #: reply to pulls with device arrays instead of host numpy — the
+        #: zero-copy mode for in-process (Loopback) planes where worker and
+        #: server share the device; cross-host Vans keep numpy replies.
+        self.device_replies = device_replies
         self.server_index = server_index
         self.partitions = {
             t: RangePartition(cfg.rows, num_servers) for t, cfg in table_cfgs.items()
@@ -60,17 +72,40 @@ class KVServer(Customer):
             return self._handle_control(msg)
         tname = msg.task.payload["table"]
         table = self.tables[tname]
-        ids = jnp.asarray(msg.keys)
+        # Bucket-pad the slice to a power of two: the worker bucket-pads its
+        # unique slots, but the per-server split (Parameter::Slice) produces
+        # arbitrary lengths again — without this every distinct length
+        # compiles a fresh device step, and the pallas kernels (block DMA)
+        # reject unaligned id vectors outright.  Pads route to the trash row
+        # with zero gradients (the established PAD contract).
+        n = int(np.asarray(msg.keys).shape[0])
+        b = _bucket(n)
+        ids_np = np.full(b, table.rows, dtype=np.int32)
+        ids_np[:n] = msg.keys
+        ids = jnp.asarray(ids_np)
         if msg.task.kind == TaskKind.PUSH:
+            vals = msg.values[0]
+            if isinstance(vals, jax.Array):  # device push: pad on device
+                if b != n:
+                    zeros = jnp.zeros((b - n,) + vals.shape[1:], vals.dtype)
+                    vals = jnp.concatenate([vals, zeros])
+            else:
+                vals = np.asarray(vals)
+                if b != n:
+                    padded = np.zeros((b,) + vals.shape[1:], dtype=vals.dtype)
+                    padded[:n] = vals
+                    vals = padded
             with self.tracer.span("kv.server.push", table=tname):
-                table.push(ids, jnp.asarray(msg.values[0]))
+                table.push(ids, jnp.asarray(vals))
             self.pushes += 1
             return msg.reply()
         elif msg.task.kind == TaskKind.PULL:
             with self.tracer.span("kv.server.pull", table=tname):
                 rows = table.pull(ids)
             self.pulls += 1
-            return msg.reply(values=[np.asarray(rows)])
+            if self.device_replies:
+                return msg.reply(values=[rows[:n]])
+            return msg.reply(values=[np.asarray(rows)[:n]])
         raise ValueError(f"unsupported task kind {msg.task.kind}")
 
     # -- checkpoint (reference SaveModel task: servers write their key-range

@@ -54,17 +54,32 @@ class KVTable:
             name: jnp.full((self.rows + 1, self.dim), fill, dtype)
             for name, fill in self.optimizer.state_shapes().items()
         }
+        #: hot-path kernel selection (VERDICT r2 #4): "pallas" routes the
+        #: gather + write-back through ops/scatter's DMA kernels — compiled
+        #: on TPU, interpreter-run elsewhere so the FULL server path stays
+        #: testable on the CPU mesh; "xla"/"auto" as documented on the flag.
+        if cfg.scatter_impl not in ("auto", "xla", "pallas"):
+            raise ValueError(
+                f"scatter_impl must be auto|xla|pallas, got {cfg.scatter_impl!r}"
+            )
+        self.scatter_impl = cfg.scatter_impl
+        self._interpret = (
+            cfg.scatter_impl == "pallas" and jax.default_backend() != "tpu"
+        )
         self._push_fn = jax.jit(self._push_impl, donate_argnums=(0, 1))
         self._pull_fn = jax.jit(self._pull_impl)
 
+    def _kern(self, fn, *args):
+        return fn(*args, impl=self.scatter_impl, interpret=self._interpret)
+
     # -- jitted bodies ------------------------------------------------------
     def _push_impl(self, value, state, ids, combined):
-        v_rows = scatter.gather_rows(value, ids)
-        s_rows = {k: scatter.gather_rows(v, ids) for k, v in state.items()}
+        v_rows = self._kern(scatter.gather_rows, value, ids)
+        s_rows = {k: self._kern(scatter.gather_rows, v, ids) for k, v in state.items()}
         new_v, new_s = self.optimizer.apply(v_rows, s_rows, combined)
-        value = scatter.scatter_update_rows_xla(value, ids, new_v)
+        value = self._kern(scatter.scatter_update_rows, value, ids, new_v)
         state = {
-            k: scatter.scatter_update_rows_xla(state[k], ids, new_s[k])
+            k: self._kern(scatter.scatter_update_rows, state[k], ids, new_s[k])
             for k in state
         }
         # Re-zero the trash row: PAD_KEY positions in real (variable-nnz)
@@ -77,8 +92,8 @@ class KVTable:
         return value, state
 
     def _pull_impl(self, value, state, ids):
-        v_rows = scatter.gather_rows(value, ids)
-        s_rows = {k: scatter.gather_rows(v, ids) for k, v in state.items()}
+        v_rows = self._kern(scatter.gather_rows, value, ids)
+        s_rows = {k: self._kern(scatter.gather_rows, v, ids) for k, v in state.items()}
         return self.optimizer.pull_weights(v_rows, s_rows)
 
     # -- public ops ---------------------------------------------------------

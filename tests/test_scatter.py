@@ -91,7 +91,7 @@ def test_pallas_rejects_unaligned_ids():
 def test_pallas_rejects_unaligned_dim():
     t = jnp.zeros((16, 100), dtype=jnp.float32)
     ids = jnp.arange(8, dtype=jnp.int32)
-    with pytest.raises(ValueError, match="dim % 128"):
+    with pytest.raises(ValueError, match="dim == 128 or dim % 1024"):
         scatter._pallas_gather(t, ids, interpret=True)
 
 
@@ -122,3 +122,126 @@ def test_pallas_interpret_matches_xla(op):
         got = scatter._pallas_scatter_add(t, ids, rows, interpret=True)
         want = scatter.scatter_add_rows_xla(t, ids, rows)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6)
+
+
+def test_pallas_scatter_set_matches_xla():
+    t = _table(rows=64, dim=128)
+    ids = jnp.asarray(
+        np.random.default_rng(3).choice(63, size=16, replace=False), jnp.int32
+    )
+    rows = jnp.asarray(
+        np.random.default_rng(4).normal(size=(16, 128)), jnp.float32
+    )
+    want = scatter.scatter_update_rows_xla(t, ids, rows)
+    got = scatter._pallas_scatter_set(t, ids, rows, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-6)
+    # dispatcher form
+    got2 = scatter.scatter_update_rows(
+        t, ids, rows, impl="pallas", interpret=True
+    )
+    np.testing.assert_allclose(np.asarray(got2), np.asarray(want), atol=1e-6)
+
+
+@pytest.mark.parametrize("n,block", [(64, None), (64, 8), (64, 32), (24, None), (96, 16)])
+def test_pallas_double_buffered_scatter_add_blocks(n, block):
+    """The double-buffered RMW kernel is exact for every block geometry.
+
+    n=24 exercises the auto-pick fallback to 8; explicit blocks exercise the
+    slot-reuse wait logic at different pipeline depths.
+    """
+    t = _table(rows=128, dim=128, seed=5)
+    rng = np.random.default_rng(6)
+    ids = jnp.asarray(rng.choice(127, size=n, replace=False), jnp.int32)
+    rows = jnp.asarray(rng.normal(size=(n, 128)), jnp.float32)
+    want = scatter.scatter_add_rows_xla(t, ids, rows)
+    got = scatter._pallas_scatter_add(
+        t, ids, rows, interpret=True, block_rows=block
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=1e-5, rtol=1e-5
+    )
+
+
+def test_pallas_block_rows_validation():
+    t = _table(rows=16, dim=128)
+    ids = jnp.arange(12, dtype=jnp.int32)  # not divisible by 8
+    with pytest.raises(ValueError, match="divisible by 8"):
+        scatter._pallas_gather(t, ids, interpret=True)
+    with pytest.raises(ValueError, match="block_rows"):
+        scatter._pallas_gather(t, jnp.arange(16, dtype=jnp.int32),
+                               interpret=True, block_rows=32)
+
+
+def test_kvserver_full_path_pallas_parity():
+    """FULL production push/pull path under scatter_impl='pallas' (VERDICT
+    r2 #4): two identical KVServer clusters, one per kernel impl, must stay
+    bitwise-close through repeated pushes with duplicates + pads."""
+    from parameter_server_tpu.config import OptimizerConfig, TableConfig
+    from parameter_server_tpu.core.postoffice import Postoffice
+    from parameter_server_tpu.core.van import LoopbackVan
+    from parameter_server_tpu.kv.server import KVServer
+    from parameter_server_tpu.kv.worker import KVWorker
+    from parameter_server_tpu.utils.keys import HashLocalizer
+
+    rows, dim = 512, 128
+    keys = (np.arange(96, dtype=np.uint64) * 7919) % 3000
+    keys = np.concatenate([keys, keys[:32]])  # duplicates pre-combine
+    rng = np.random.RandomState(0)
+    grads = rng.randn(keys.size, dim).astype(np.float32)
+
+    pulled = {}
+    for impl in ("xla", "pallas"):
+        cfgs = {
+            "e": TableConfig(
+                name="e", rows=rows, dim=dim,
+                optimizer=OptimizerConfig(kind="adagrad", learning_rate=0.1),
+                scatter_impl=impl,
+            )
+        }
+        van = LoopbackVan()
+        try:
+            servers = [
+                KVServer(Postoffice(f"S{i}", van), cfgs, i, 2)
+                for i in range(2)
+            ]
+            worker = KVWorker(
+                Postoffice("W0", van), cfgs, 2, min_bucket=16,
+                localizers={"e": HashLocalizer(rows)},
+            )
+            for _ in range(3):
+                worker.wait(worker.push("e", keys, grads), timeout=30)
+            pulled[impl] = worker.pull_sync("e", keys, timeout=30)
+        finally:
+            van.close()
+    np.testing.assert_allclose(
+        pulled["pallas"], pulled["xla"], atol=1e-5, rtol=1e-5
+    )
+
+
+@pytest.mark.parametrize("dim", [1024, 2048])
+def test_pallas_chunked_wide_rows(dim):
+    """Wide rows (transformer d_model) DMA as (dim//128, 128) chunks of the
+    (rows*c, 128) view — the layout Mosaic accepts for dim % 1024 == 0."""
+    rng = np.random.default_rng(8)
+    t = jnp.asarray(rng.normal(size=(64, dim)), jnp.float32)
+    ids = jnp.asarray(rng.choice(63, size=16, replace=False), jnp.int32)
+    rows = jnp.asarray(rng.normal(size=(16, dim)), jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(scatter._pallas_gather(t, ids, interpret=True)),
+        np.asarray(jnp.take(t, ids, axis=0)), atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(scatter._pallas_scatter_add(t, ids, rows, interpret=True)),
+        np.asarray(scatter.scatter_add_rows_xla(t, ids, rows)),
+        atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(scatter._pallas_scatter_set(t, ids, rows, interpret=True)),
+        np.asarray(scatter.scatter_update_rows_xla(t, ids, rows)), atol=1e-6)
+
+
+def test_pallas_rejects_unsupported_dim():
+    t = jnp.zeros((16, 256), jnp.float32)  # 256: single-row slice unaligned
+    with pytest.raises(ValueError, match="dim == 128 or dim % 1024"):
+        scatter._pallas_gather(t, jnp.arange(8, dtype=jnp.int32), interpret=True)
+    # and auto mode silently falls back to XLA
+    out = scatter.gather_rows(t, jnp.arange(8, dtype=jnp.int32), impl="auto")
+    assert out.shape == (8, 256)
