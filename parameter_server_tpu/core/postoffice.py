@@ -155,6 +155,11 @@ class Customer:
         with self._cond:
             return ts not in self._pending
 
+    def pending_count(self) -> int:
+        """Number of tasks still awaiting responses (in-flight depth)."""
+        with self._cond:
+            return len(self._pending)
+
     def responses(self, ts: int) -> list[Message]:
         """Collected response messages for a completed kept task."""
         with self._cond:

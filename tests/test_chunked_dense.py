@@ -1,0 +1,243 @@
+"""Chunked dense KV plane (config #4 spine): per-segment overlapped
+push/pull with byte accounting and loss parity vs the monolithic path.
+
+VERDICT r2 missing #2 / next #1: whole-vector pushes make BERT-over-DCN
+infeasible; these tests prove the segment pipeline (a) covers the vector
+exactly, (b) keeps >= 2 chunks in flight, (c) matches the monolithic path
+loss-for-loss under BSP, and (d) reports bytes/step — compressed wire bytes
+included when a FilterChain rides the Van.
+"""
+
+import io
+import json
+
+import numpy as np
+import pytest
+
+import jax
+
+from parameter_server_tpu.config import (
+    ConsistencyConfig,
+    ConsistencyMode,
+    OptimizerConfig,
+)
+from parameter_server_tpu.core.filters import (
+    CompressingFilter,
+    FilterChain,
+    FixingFloatFilter,
+)
+from parameter_server_tpu.core.postoffice import Postoffice
+from parameter_server_tpu.core.van import LoopbackVan
+from parameter_server_tpu.kv.dense import (
+    DenseKVServer,
+    DenseKVWorker,
+    PytreeCodec,
+    fixed_segments,
+    layer_segments,
+)
+from parameter_server_tpu.learner.dense import ChunkedAsyncDenseLearner
+from parameter_server_tpu.models import transformer as tfm
+from parameter_server_tpu.utils import metrics as metrics_lib
+
+
+def test_fixed_segments_cover_exactly():
+    segs = fixed_segments(1000, 256)
+    assert segs[0] == (0, 256)
+    assert segs[-1] == (768, 1000)
+    assert sum(b - a for a, b in segs) == 1000
+    with pytest.raises(ValueError):
+        fixed_segments(10, 0)
+
+
+def test_layer_segments_split_and_coalesce():
+    tree = {
+        "a": np.zeros(10),      # coalesces with b
+        "b": np.zeros(20),
+        "c": np.zeros(100),     # giant: splits into 40-chunks
+        "d": np.zeros(5),
+    }
+    segs = layer_segments(tree, max_elems=40)
+    # full coverage, in flatten order, no overlap
+    assert segs[0][0] == 0 and segs[-1][1] == 135
+    for (a1, b1), (a2, b2) in zip(segs, segs[1:]):
+        assert b1 == a2
+    assert all(b - a <= 40 for a, b in segs)
+
+
+def _bert_tiny_setup(seed=0):
+    cfg = tfm.tiny_config(causal=False)
+    model = tfm.Transformer(cfg)
+    tok0 = np.zeros((1, 8), np.int32)
+    params = model.init(jax.random.PRNGKey(seed), tok0)["params"]
+
+    def loss_fn(params, inputs, targets, mask):
+        logits = model.apply({"params": params}, inputs)
+        return tfm.mlm_loss(logits, targets, mask)
+
+    return cfg, model, params, loss_fn
+
+
+def _mlm_batch_fn(cfg, seed):
+    from parameter_server_tpu.learner.lm import make_mlm_batch
+
+    rng = np.random.default_rng(seed)
+
+    def fn():
+        # a NARROW unigram distribution: masked-token prediction then has
+        # learnable structure (entropy log 20 << log vocab), so the loss
+        # verifiably falls from its log-vocab starting point
+        tokens = rng.integers(1, 20, size=(8, 16))
+        return make_mlm_batch(tokens, cfg.vocab_size, rng)
+
+    return fn
+
+
+def _cluster(van, total, num_servers, init_vec, lr=0.1):
+    opt = OptimizerConfig(kind="adagrad", learning_rate=lr)
+    servers = [
+        DenseKVServer(
+            Postoffice(f"S{i}", van),
+            {"model": (total, opt)},
+            i,
+            num_servers,
+            init_vectors={"model": init_vec},
+        )
+        for i in range(num_servers)
+    ]
+    worker = DenseKVWorker(Postoffice("W0", van), {"model": total}, num_servers)
+    return servers, worker
+
+
+def _run_chunked(chunk_elems, *, van=None, steps=5, jsonl=None, max_delay=0):
+    cfg, _model, params, loss_fn = _bert_tiny_setup()
+    codec = PytreeCodec(params)
+    own_van = van is None
+    van = van or LoopbackVan()
+    try:
+        _servers, worker = _cluster(van, codec.total, 2, codec.flatten(params))
+        learner = ChunkedAsyncDenseLearner(
+            loss_fn,
+            params,
+            [worker],
+            ConsistencyConfig(
+                mode=ConsistencyMode.SSP if max_delay else ConsistencyMode.BSP,
+                max_delay=max_delay,
+            ),
+            chunk_elems=chunk_elems,
+            dashboard=metrics_lib.Dashboard(jsonl=jsonl, print_every=0),
+        )
+        losses = learner.run([_mlm_batch_fn(cfg, 7)], steps, timeout=120)
+        return losses, learner, worker
+    finally:
+        if own_van:
+            van.close()
+
+
+def test_segment_push_pull_roundtrip():
+    """Segment pulls reassemble exactly what whole-vector pulls see."""
+    cfg, _m, params, _l = _bert_tiny_setup()
+    codec = PytreeCodec(params)
+    van = LoopbackVan()
+    try:
+        init = codec.flatten(params)
+        _servers, worker = _cluster(van, codec.total, 3, init)
+        whole = worker.pull_sync("model", timeout=30)
+        np.testing.assert_allclose(whole, init, rtol=1e-6)
+        out = np.zeros_like(whole)
+        for a, b in fixed_segments(codec.total, 1777):  # odd size: spans servers
+            ts = worker.pull_segment("model", a, b - a)
+            out[a:b] = worker.pull_segment_result(ts, timeout=30)
+        np.testing.assert_allclose(out, whole, rtol=1e-6)
+        # segment push touches exactly its range
+        g = np.ones(500, np.float32)
+        worker.wait(worker.push_segment("model", 1000, g), timeout=30)
+        after = worker.pull_sync("model", timeout=30)
+        np.testing.assert_allclose(after[:1000], whole[:1000], rtol=1e-6)
+        np.testing.assert_allclose(after[1500:], whole[1500:], rtol=1e-6)
+        assert not np.allclose(after[1000:1500], whole[1000:1500])
+    finally:
+        van.close()
+
+
+def test_chunked_matches_monolithic_bert_tiny():
+    """BSP chunked (many segments) == single-segment (monolithic) losses."""
+    mono, _l1, _w1 = _run_chunked(chunk_elems=1 << 30)  # one segment
+    sink = io.StringIO()
+    chunked, learner, worker = _run_chunked(chunk_elems=4096, jsonl=sink)
+    assert len(mono) == len(chunked) == 5
+    np.testing.assert_allclose(chunked, mono, rtol=1e-4, atol=1e-5)
+    # loss actually falls (it's training, not a no-op)
+    assert chunked[-1] < chunked[0]
+    # >= 2 chunks genuinely in flight
+    assert learner.max_inflight >= 2, learner.max_inflight
+    # byte accounting rode the dashboard
+    rows = [json.loads(line) for line in sink.getvalue().splitlines()]
+    assert all(r["push_mb"] > 0 for r in rows)
+    assert all(r["pull_mb"] > 0 for r in rows)
+    total_mb = PytreeCodec(_bert_tiny_setup()[2]).total * 4 / 1e6
+    # each step pushes and pulls the whole vector once, in segments
+    assert abs(rows[0]["push_mb"] - total_mb) / total_mb < 0.01
+
+
+def test_chunked_with_wire_filters():
+    """FilterChain (zlib + int8) on the segment traffic: training still
+    converges and the dashboard reports compressed wire bytes."""
+    # order matters: quantize f32 -> int8 FIRST, then zlib the int8 bytes —
+    # zlib over raw float mantissas compresses ~nothing
+    chain = FilterChain([FixingFloatFilter(), CompressingFilter(level=1)])
+    van = LoopbackVan(filter_chain=chain)
+    sink = io.StringIO()
+    losses, _learner, worker = _run_chunked(
+        chunk_elems=8192, van=van, steps=5, jsonl=sink
+    )
+    van.close()
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]  # int8 wire grads still train
+    rows = [json.loads(line) for line in sink.getvalue().splitlines()]
+    assert rows[-1]["wire_mb_total"] > 0
+    # int8 + zlib on near-normal grads: wire bytes well under raw f32 bytes
+    raw_mb = sum(r["push_mb"] + r["pull_mb"] for r in rows)
+    assert rows[-1]["wire_mb_total"] < 0.6 * raw_mb
+
+
+def test_chunked_ssp_window_two_workers():
+    """SSP tau=1 with 2 workers over layer segments: finite, decreasing."""
+    cfg, _m, params, loss_fn = _bert_tiny_setup()
+    codec = PytreeCodec(params)
+    van = LoopbackVan()
+    try:
+        # two async workers double the update pressure: a calmer lr keeps
+        # the tiny model descending instead of oscillating
+        opt = OptimizerConfig(kind="adagrad", learning_rate=0.02)
+        init_vec = PytreeCodec(params).flatten(params)
+        servers = [
+            DenseKVServer(
+                Postoffice(f"S{i}", van),
+                {"model": (codec.total, opt)},
+                i,
+                2,
+                init_vectors={"model": init_vec},
+            )
+            for i in range(2)
+        ]
+        workers = [
+            DenseKVWorker(
+                Postoffice(f"W{i}", van), {"model": codec.total}, 2,
+            )
+            for i in range(2)
+        ]
+        learner = ChunkedAsyncDenseLearner(
+            loss_fn,
+            params,
+            workers,
+            ConsistencyConfig(mode=ConsistencyMode.SSP, max_delay=1),
+            segments=layer_segments(params, max_elems=16384),
+        )
+        losses = learner.run(
+            [_mlm_batch_fn(cfg, 11), _mlm_batch_fn(cfg, 13)], 6, timeout=120
+        )
+        assert len(losses) == 12
+        assert np.isfinite(losses).all()
+        assert np.mean(losses[-3:]) < np.mean(losses[:3])
+    finally:
+        van.close()
