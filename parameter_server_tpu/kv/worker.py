@@ -100,6 +100,41 @@ class KVWorker(Customer):
                 )
             return self.submit(msgs)
 
+    def push_device(self, table: str, keys: np.ndarray, values) -> int:
+        """Device-resident push: gradient rows never leave the device.
+
+        Only the (small, int) keys are handled on the host; the value rows
+        are a ``jax.Array`` that is duplicate-combined on device and sliced
+        per server as device views.  Over the LoopbackVan those views flow
+        to the server tables with no host round-trip — the SArray-zero-copy
+        role of SURVEY §2 #19 in its TPU form.  (A cross-host Van serializes
+        at its own boundary, which is where the reference copies too.)
+        """
+        import jax.numpy as jnp  # local alias keeps the hot path explicit
+
+        with self.tracer.span("kv.push", table=table, n=int(keys.size)):
+            cfg = self.table_cfgs[table]
+            vals = values.reshape(keys.size, cfg.dim)
+            slots, inverse, _n = localize_to_slots(
+                keys, self.localizers[table], min_bucket=self.min_bucket
+            )
+            combined = _segment_combine(
+                jnp.asarray(inverse), vals, slots.shape[0]
+            )
+            msgs = []
+            for s, seg, local in self.partitions[table].slice_ids(slots):
+                msgs.append(
+                    Message(
+                        task=Task(
+                            TaskKind.PUSH, self.name, payload={"table": table}
+                        ),
+                        recver=server_id(s),
+                        keys=local,
+                        values=[combined[seg]],
+                    )
+                )
+            return self.submit(msgs)
+
     # -- pull ---------------------------------------------------------------
     def pull(self, table: str, keys: np.ndarray) -> int:
         """Request weights for ``keys``; fetch with :meth:`pull_result`."""
@@ -153,6 +188,42 @@ class KVWorker(Customer):
             seg = plan["order"][resp.sender]
             uniq_rows[seg] = resp.values[0]
         out = uniq_rows[plan["inverse"]]
+        if cfg.dim == 1:
+            return out.reshape(plan["shape"])
+        return out.reshape(plan["shape"] + (cfg.dim,))
+
+    def pull_result_device(self, ts: int, timeout: Optional[float] = None):
+        """Like :meth:`pull_result` but assembles rows ON DEVICE.
+
+        Servers replying with device arrays (``KVServer(device_replies=
+        True)``) never touch host memory; numpy replies are uploaded once.
+        Returns a ``jax.Array`` of shape ``keys.shape + (dim,)`` (or
+        ``keys.shape`` for dim=1).
+        """
+        import jax
+        import jax.numpy as jnp
+
+        with self.tracer.span("kv.pull.wait", ts=ts):
+            completed = self.wait(ts, timeout)
+        plan = self._pull_plans.pop(ts)
+        errs = self.errors(ts)
+        responses = self.take_responses(ts)
+        if not completed:
+            raise TimeoutError(f"pull ts={ts} timed out")
+        if errs:
+            raise RuntimeError(f"pull ts={ts} failed on: " + "; ".join(errs))
+        if len(responses) < len(plan["order"]):
+            raise RuntimeError(
+                f"pull ts={ts} incomplete: {len(responses)}/"
+                f"{len(plan['order'])} servers answered (dead server?)"
+            )
+        cfg = self.table_cfgs[plan["table"]]
+        uniq = jnp.zeros((plan["n_slots"], cfg.dim), jnp.dtype(cfg.dtype))
+        for resp in responses:
+            seg = plan["order"][resp.sender]
+            rows = jnp.asarray(resp.values[0]).reshape(-1, cfg.dim)
+            uniq = jax.lax.dynamic_update_slice(uniq, rows, (seg.start, 0))
+        out = jnp.take(uniq, jnp.asarray(plan["inverse"]), axis=0)
         if cfg.dim == 1:
             return out.reshape(plan["shape"])
         return out.reshape(plan["shape"] + (cfg.dim,))

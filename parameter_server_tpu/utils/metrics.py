@@ -84,6 +84,7 @@ class Dashboard:
     _last_t: Optional[float] = None
     _examples: int = 0
     _header_printed: bool = False
+    _attr_last: dict = dataclasses.field(default_factory=dict)
 
     def record(self, iteration: int, objective: float, extra: Optional[dict] = None,
                examples: int = 0) -> None:
@@ -119,9 +120,16 @@ class Dashboard:
             row.update(extra)
         printing = self.print_every and iteration % self.print_every == 0
         if self.tracer is not None and (printing or self.jsonl is not None):
+            # interval DELTAS (this row's share), from the tracer's O(1)
+            # running totals — not a scan of the span deque, and not a
+            # misleading cumulative sum per row
+            attr = self.attribution()
             row["spans_s"] = {
-                k: round(v, 4) for k, v in self.attribution().items()
+                k: round(v - self._attr_last.get(k, 0.0), 4)
+                for k, v in attr.items()
+                if v - self._attr_last.get(k, 0.0) > 0
             }
+            self._attr_last = attr
         if self.jsonl is not None:
             self.jsonl.write(json.dumps(row) + "\n")
             self.jsonl.flush()
@@ -140,15 +148,19 @@ class Dashboard:
             )
 
     def attribution(self) -> dict:
-        """Seconds per span name from the attached tracer.
+        """Cumulative seconds per span name from the attached tracer.
 
         Trainers record spans named by plane (e.g. ``host.assemble``,
         ``h2d``, ``device.step``, ``kv.push``); this sums their durations so
         a step-time budget — where did the wall clock actually go — rides
-        next to the throughput numbers (SURVEY §5 observability).
+        next to the throughput numbers (SURVEY §5 observability).  Uses the
+        tracer's O(1) running totals when available (hot-path safe).
         """
         if self.tracer is None:
             return {}
+        totals = getattr(self.tracer, "totals", None)
+        if callable(totals):
+            return totals()
         out: dict = {}
         for name, _start, dur, _tid, _attrs in self.tracer.spans():
             out[name] = out.get(name, 0.0) + dur

@@ -32,6 +32,10 @@ class Tracer:
     def __init__(self, *, capacity: int = 100_000, enabled: bool = True) -> None:
         self.enabled = enabled
         self._spans: collections.deque[Span] = collections.deque(maxlen=capacity)
+        #: O(1)-maintained per-name duration sums — unlike the bounded span
+        #: deque these never drop history, so dashboards can poll cheap
+        #: cumulative attribution without scanning (Dashboard.attribution).
+        self._totals: Dict[str, float] = {}
         self._lock = threading.Lock()
         self._t0 = time.perf_counter()
 
@@ -50,6 +54,7 @@ class Tracer:
                     (name, start - self._t0, dur, threading.get_ident(),
                      attrs or None)
                 )
+                self._totals[name] = self._totals.get(name, 0.0) + dur
 
     def record(self, name: str, duration_s: float, **attrs) -> None:
         """Record an externally timed span (e.g. from a callback)."""
@@ -60,6 +65,12 @@ class Tracer:
                 (name, time.perf_counter() - self._t0 - duration_s,
                  duration_s, threading.get_ident(), attrs or None)
             )
+            self._totals[name] = self._totals.get(name, 0.0) + duration_s
+
+    def totals(self) -> Dict[str, float]:
+        """Cumulative seconds per span name (O(names), never drops spans)."""
+        with self._lock:
+            return dict(self._totals)
 
     def spans(self, name: Optional[str] = None) -> List[Span]:
         with self._lock:
@@ -69,6 +80,7 @@ class Tracer:
     def clear(self) -> None:
         with self._lock:
             self._spans.clear()
+            self._totals.clear()
 
     # -- aggregation ---------------------------------------------------------
     def histogram(self, name: str) -> dict:
