@@ -31,7 +31,8 @@ def _single_process_losses():
         nnz=8,
         mesh_data=2,
         seed=0,
-    )
+        data_shards=4,
+    )["losses"]
 
 
 def test_local_batch_slice():
@@ -57,6 +58,7 @@ def test_multiprocess_matches_single_process_losses():
         mesh_data=2,
         seed=0,
         timeout=240.0,
+        data_shards=4,
     )
     assert result["returncodes"] == [0, 0], result
     assert sorted(result["losses"]) == [0, 1]
@@ -64,10 +66,13 @@ def test_multiprocess_matches_single_process_losses():
     np.testing.assert_allclose(
         result["losses"][0], result["losses"][1], rtol=1e-6
     )
-    # and it matches the single-process run over the same (2, 4) mesh
+    # and it matches the single-process run over the same (2, 4) mesh —
+    # even though each process now GENERATES only its own data shards
     np.testing.assert_allclose(
         result["losses"][0], single, rtol=1e-4, atol=1e-6
     )
+    # per-process streams are genuinely different (no shared global stream)
+    assert result["digests"][0] != result["digests"][1], result["digests"]
 
 
 def test_multiprocess_rows_sharded_across_hosts():
@@ -89,3 +94,42 @@ def test_multiprocess_rows_sharded_across_hosts():
     losses = result["losses"][0]
     assert np.all(np.isfinite(losses))
     assert losses[-1] < losses[0]
+
+
+def test_kill_and_rejoin_resumes_from_checkpoint(tmp_path):
+    """Elasticity on the pod path (VERDICT r2 #6): kill proc 1 mid-run,
+    relaunch, resume from checkpoint — the resumed trajectory must equal an
+    uninterrupted run's suffix exactly (optimizer state + data schedule both
+    restored)."""
+    ckpt = str(tmp_path / "spmd_ckpt")
+    common = dict(
+        num_procs=2, cpu_devices=4, steps=STEPS, rows=ROWS,
+        global_batch=GLOBAL_BATCH, nnz=8, mesh_data=2, seed=0,
+        timeout=240.0, data_shards=4,
+    )
+    # ground truth: uninterrupted
+    base = launch_spmd(**common)
+    assert base["returncodes"] == [0, 0], base
+
+    # run with checkpoints every 2 steps; proc 1 dies hard after step 3
+    broken = launch_spmd(
+        **common, ckpt_root=ckpt, ckpt_every=2, die_after_step=3, die_proc=1
+    )
+    assert 17 in broken["returncodes"], broken  # the injected death
+    import os
+
+    assert os.path.exists(
+        os.path.join(ckpt, "spmd_step000002.npz")
+    ), os.listdir(ckpt)
+
+    # relaunch-and-rejoin: resumes from step 2, finishes the job
+    resumed = launch_spmd(
+        **common, ckpt_root=ckpt, ckpt_every=2, resume=True
+    )
+    assert resumed["returncodes"] == [0, 0], resumed
+    assert resumed["start_steps"][0] == 2, resumed["start_steps"]
+    assert len(resumed["losses"][0]) == STEPS - 2
+    # exact continuation of the uninterrupted trajectory
+    np.testing.assert_allclose(
+        resumed["losses"][0], base["losses"][0][2:], rtol=1e-5, atol=1e-6
+    )

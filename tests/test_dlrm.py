@@ -40,3 +40,111 @@ def test_dlrm_embedding_table_sharded():
     assert trainer.emb_value.addressable_shards[0].data.shape[0] == (
         trainer.total_rows // 4
     )
+
+
+def test_dlrm_16m_rows_rows_mode_memory_and_step():
+    """2^24-row table trains rows-mode: per-step temp memory O(batch),
+    never O(table) (the billion-row scaling argument, VERDICT r2 #5).
+
+    Asserted from XLA's compiled memory analysis: the train step's temp
+    allocation must be far below the table size — dense-fused would move
+    the whole 128 MB value (+state) per step.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from parameter_server_tpu.utils.keys import localize_to_slots
+
+    rows = 1 << 24
+    mesh = mesh_lib.make_mesh((2, 4))
+    cfg = TableConfig(
+        name="emb",
+        rows=rows,
+        dim=2,
+        optimizer=OptimizerConfig(kind="adagrad", learning_rate=0.05),
+        init_scale=0.0,  # zeros init: no O(table) random temp at setup
+    )
+    data = SyntheticDLRM(key_space=1 << 30, batch_size=128, seed=1)
+    trainer = SpmdDLRMTrainer(
+        cfg, mesh, n_dense=data.n_dense, n_sparse=data.n_sparse,
+        min_bucket=1024,
+    )
+    keys, dense, labels = data.next_batch()
+    # repeat one batch: after a few steps the loss must be below the start
+    # (single-step comparisons are adam-warmup noise)
+    rep = [trainer.step(keys, dense, labels) for _ in range(5)]
+    assert np.isfinite(rep).all()
+    assert rep[-1] < rep[0], rep
+
+    # compiled-step temp memory: O(batch-rows), a small fraction of table
+    slots, inverse, _ = localize_to_slots(
+        keys, trainer.localizer, min_bucket=1024
+    )
+    args = (
+        trainer.emb_value, trainer.emb_state, trainer.mlp_params,
+        trainer.opt_state, jnp.asarray(slots), jnp.asarray(inverse),
+        jnp.asarray(dense), jnp.asarray(labels),
+    )
+    ma = trainer._step.lower(*args).compile().memory_analysis()
+    table_bytes = trainer.emb_value.nbytes * (1 + len(trainer.emb_state))
+    assert ma.temp_size_in_bytes < table_bytes / 8, (
+        ma.temp_size_in_bytes, table_bytes,
+    )
+
+
+def test_tail_filter_masks_rare_keys_and_trainer_still_learns():
+    """Count-min tail filter on the input stream (DARLIN preprocess role):
+    rare keys mask to PAD, frequent keys survive, DLRM still trains."""
+    from parameter_server_tpu.data.tailfilter import TailFilteredStream
+    from parameter_server_tpu.utils.keys import PAD_KEY
+
+    data = SyntheticDLRM(key_space=1 << 20, batch_size=256, seed=2)
+    # zipf-ify: square the stream keys onto a narrow head + long tail
+    rng = np.random.default_rng(3)
+
+    def batch_fn():
+        keys, dense, labels = data.next_batch()
+        head = rng.integers(0, 64, size=keys.shape, dtype=np.uint64)
+        tail = rng.integers(0, 1 << 40, size=keys.shape, dtype=np.uint64)
+        use_head = rng.random(keys.shape) < 0.7
+        return np.where(use_head, head, tail), dense, labels
+
+    stream = TailFilteredStream(batch_fn, threshold=3)
+    mesh = mesh_lib.make_mesh((4, 2))
+    trainer = SpmdDLRMTrainer(
+        _cfg(rows=1 << 14), mesh, n_dense=data.n_dense,
+        n_sparse=data.n_sparse, learning_rate=0.005, min_bucket=1024,
+    )
+    losses = []
+    for _ in range(20):
+        keys, dense, labels = stream()
+        losses.append(trainer.step(keys, dense, labels))
+    # the one-shot tail got masked; the head survived
+    assert 0.05 < stream.masked_fraction < 0.6, stream.masked_fraction
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-5:]) < np.mean(losses[:5])
+
+
+def test_tail_filter_never_drops_frequent_keys():
+    from parameter_server_tpu.data.tailfilter import TailFilteredStream
+    from parameter_server_tpu.utils.keys import PAD_KEY
+
+    frequent = np.arange(1, 9, dtype=np.uint64)
+
+    def batch_fn():
+        return (np.tile(frequent, (4, 1)),)
+
+    stream = TailFilteredStream(batch_fn, threshold=2)
+    stream()  # first sight: counts reach 4 per key (>= threshold)
+    (keys2,) = stream()
+    np.testing.assert_array_equal(keys2, np.tile(frequent, (4, 1)))
+    # PAD positions pass through untouched and are not counted
+    def batch_fn_pad():
+        k = np.tile(frequent, (4, 1))
+        k[:, -1] = PAD_KEY
+        return (k,)
+
+    stream2 = TailFilteredStream(batch_fn_pad, threshold=1)
+    (out,) = stream2()
+    assert (out[:, -1] == PAD_KEY).all()
+    assert stream2.seen == 4 * 7
