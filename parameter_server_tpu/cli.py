@@ -115,6 +115,11 @@ def build_parser() -> argparse.ArgumentParser:
     la.add_argument("--rows", type=int, default=1 << 14)
     la.add_argument("--batch-size", type=int, default=256)
     la.add_argument("--ckpt-root", default=None)
+    la.add_argument(
+        "--filters", default="none",
+        choices=["none", "zlib", "int8", "int8+zlib", "full"],
+        help="wire filter stack on the TcpVan",
+    )
     la.set_defaults(fn=_cmd_launch)
 
     sp = sub.add_parser(
@@ -163,6 +168,7 @@ def _cmd_launch(args: argparse.Namespace) -> int:
         rows=args.rows,
         batch_size=args.batch_size,
         ckpt_root=args.ckpt_root,
+        filters=args.filters,
     )
     print(json.dumps(result))
     return 0 if all(rc == 0 for rc in result["returncodes"]) else 1

@@ -46,6 +46,10 @@ class Filter:
     the Van applies chains concurrently from many sender threads."""
 
     name = "base"
+    #: True when encode/decode need no per-link shared state, so the codec
+    #: may run on paths without a route-table identity (e.g. TcpVan replies
+    #: over the requester's connection).  KeyCaching is the stateful one.
+    stateless = True
 
     def encode(self, msg: Message) -> Message:
         return msg
@@ -63,6 +67,7 @@ class KeyCachingFilter(Filter):
     """
 
     name = "key_caching"
+    stateless = False
 
     def __init__(self) -> None:
         self._send_cache: Dict[tuple, Tuple[int, np.ndarray]] = {}
@@ -123,7 +128,12 @@ class KeyCachingFilter(Filter):
 
 
 class CompressingFilter(Filter):
-    """zlib-compress value arrays (the reference's LZ4 role)."""
+    """zlib-compress value AND key arrays (the reference's LZ4 role).
+
+    Keys matter as much as values on this wire: pull requests are nothing
+    but keys, and the sorted unique row ids the worker ships compress far
+    better than random bytes.
+    """
 
     name = "compressing"
 
@@ -133,21 +143,29 @@ class CompressingFilter(Filter):
         self.bytes_out = 0
         self._lock = threading.Lock()  # counters only; codec is stateless
 
+    def _compress(self, arr: np.ndarray) -> np.ndarray:
+        raw = np.ascontiguousarray(arr).tobytes()
+        comp = zlib.compress(raw, self.level)
+        with self._lock:
+            self.bytes_in += len(raw)
+            self.bytes_out += len(comp)
+        return np.frombuffer(comp, np.uint8)
+
     def encode(self, msg: Message) -> Message:
         out = _msg_copy(msg)
         blobs = []
         meta = []
         for v in msg.values:
-            v = np.ascontiguousarray(v)
-            raw = v.tobytes()
-            comp = zlib.compress(raw, self.level)
-            with self._lock:
-                self.bytes_in += len(raw)
-                self.bytes_out += len(comp)
-            blobs.append(np.frombuffer(comp, np.uint8))
+            v = np.asarray(v)
+            blobs.append(self._compress(v))
             meta.append((v.dtype.str, v.shape))
         out.values = blobs
-        out.task.payload = dict(msg.task.payload, zlib_meta=meta)
+        payload = dict(msg.task.payload, zlib_meta=meta)
+        if msg.keys is not None:
+            k = np.asarray(msg.keys)
+            out.keys = self._compress(k)
+            payload["zlib_keys"] = (k.dtype.str, k.shape)
+        out.task.payload = payload
         return out
 
     def decode(self, msg: Message) -> Message:
@@ -161,8 +179,16 @@ class CompressingFilter(Filter):
             ).reshape(shape)
             for b, (dt, shape) in zip(msg.values, meta)
         ]
+        kmeta = msg.task.payload.get("zlib_keys")
+        if kmeta is not None and msg.keys is not None:
+            dt, shape = kmeta
+            out.keys = np.frombuffer(
+                zlib.decompress(np.asarray(msg.keys).tobytes()), np.dtype(dt)
+            ).reshape(shape)
         out.task.payload = {
-            k: v for k, v in msg.task.payload.items() if k != "zlib_meta"
+            k: v
+            for k, v in out.task.payload.items()
+            if k not in ("zlib_meta", "zlib_keys")
         }
         return out
 
@@ -185,14 +211,20 @@ class FixingFloatFilter(Filter):
         for v in msg.values:
             v = np.asarray(v)
             if v.dtype == np.float32 and v.size:
+                # Per-row scales only pay off for wide rows: each costs 4 B
+                # of (uncompressed, header-borne) f32, so on narrow arrays —
+                # the dim=1 LR tables — they would rival the int8 payload
+                # itself and INFLATE wire bytes.  Narrow arrays get one
+                # per-tensor scale.
+                per_row = v.ndim >= 2 and v.shape[-1] >= 16
                 if self.stochastic:  # only the RNG path needs the lock
                     with self._lock:
                         q, s = quantize_int8(
-                            v, per_row=v.ndim >= 2, stochastic=True,
+                            v, per_row=per_row, stochastic=True,
                             rng=self._rng,
                         )
                 else:
-                    q, s = quantize_int8(v, per_row=v.ndim >= 2)
+                    q, s = quantize_int8(v, per_row=per_row)
                 vals.append(q)
                 scales.append(s)
                 quantized.append(True)
@@ -239,3 +271,42 @@ class FilterChain:
         for f in reversed(self.filters):
             msg = f.decode(msg)
         return msg
+
+    def stateless_subchain(self) -> "FilterChain":
+        """The per-link-state-free filters, SAME instances (shared counters).
+
+        Decode is marker-driven (each filter acts only on its own payload
+        keys), so a receiver's full chain correctly decodes messages encoded
+        with this subset — the Van uses it on reply paths that lack a
+        route-table link identity.
+        """
+        return FilterChain([f for f in self.filters if f.stateless])
+
+    def compressed_bytes(self) -> Tuple[int, int]:
+        """(bytes_in, bytes_out) summed over compressing members."""
+        bi = bo = 0
+        for f in self.filters:
+            if isinstance(f, CompressingFilter):
+                bi += f.bytes_in
+                bo += f.bytes_out
+        return bi, bo
+
+
+def make_chain(spec: str) -> Optional[FilterChain]:
+    """Build a chain from a launcher-friendly spec string.
+
+    ``"none"`` -> None; ``"zlib"`` -> compression only; ``"int8"`` ->
+    quantization only; ``"int8+zlib"`` -> quantize then compress (the
+    useful DCN stack: zlib over raw float mantissas saves ~nothing);
+    ``"full"`` -> key-caching + int8 + zlib (the reference's default trio).
+    """
+    parts = {
+        "none": [],
+        "zlib": [CompressingFilter()],
+        "int8": [FixingFloatFilter()],
+        "int8+zlib": [FixingFloatFilter(), CompressingFilter()],
+        "full": [KeyCachingFilter(), FixingFloatFilter(), CompressingFilter()],
+    }
+    if spec not in parts:
+        raise ValueError(f"unknown filter spec {spec!r}; have {sorted(parts)}")
+    return FilterChain(parts[spec]) if parts[spec] else None

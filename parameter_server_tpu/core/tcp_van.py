@@ -164,6 +164,7 @@ class TcpVan(Van):
         self.port = actual.value
         self.advertise_host = advertise_host or "127.0.0.1"
         self.filter_chain = filter_chain
+        self._stateless_chain = None  # lazily-built reply-path subchain
         #: bound local nodes: per-node inbox + single handler thread, exactly
         #: like LoopbackVan — KVServer table mutation relies on each node's
         #: handler being single-threaded by construction.
@@ -253,9 +254,17 @@ class TcpVan(Van):
             with self._lock:
                 self.dropped_messages += 1
             return False
-        # NOTE: filters are skipped on this path — filter state is keyed per
-        # link and the requester decodes replies with its own chain; the
-        # symmetric encode would need the same route-table entry we lack.
+        # STATELESS filters only on this path (compression/quantization):
+        # per-link state (key caching) is keyed by the route-table identity
+        # we lack here, but the codec filters are marker-driven — the
+        # requester's full chain decodes them fine.  Pull replies are the
+        # bulk of DCN bytes, so skipping them entirely (as before) forfeited
+        # most of the compression win.
+        if self.filter_chain is not None:
+            sub = self._stateless_chain
+            if sub is None:
+                sub = self._stateless_chain = self.filter_chain.stateless_subchain()
+            msg = sub.encode(msg)
         data = serialize_message(msg)
         buf = ctypes.cast(ctypes.c_char_p(data), _u8p)
         rc = self._lib.ps_van_send(self._van, conn, buf, len(data))
