@@ -67,6 +67,24 @@ def _cmd_eval(args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_serve(args: argparse.Namespace) -> int:
+    """Run the psfs shard file server (reference file.h/HDFS host role)."""
+    import threading
+
+    from parameter_server_tpu.data.fs import FileServer
+
+    srv = FileServer(
+        args.root, host=args.host, port=args.port,
+        advertise_host=args.advertise_host,
+    ).start()
+    print(json.dumps({"url": srv.url, "root": srv.root}), flush=True)
+    try:
+        threading.Event().wait()  # serve until interrupted
+    except KeyboardInterrupt:
+        srv.stop()
+    return 0
+
+
 def _cmd_apps(_args: argparse.Namespace) -> int:
     from parameter_server_tpu import app as app_lib
 
@@ -104,6 +122,16 @@ def build_parser() -> argparse.ArgumentParser:
 
     apps = sub.add_parser("apps", help="list registered apps")
     apps.set_defaults(fn=_cmd_apps)
+
+    se = sub.add_parser(
+        "serve",
+        help="serve a shard directory over psfs:// (readers stream from it)",
+    )
+    se.add_argument("root")
+    se.add_argument("--host", default="0.0.0.0")
+    se.add_argument("--port", type=int, default=0)
+    se.add_argument("--advertise-host", default="127.0.0.1")
+    se.set_defaults(fn=_cmd_serve)
 
     la = sub.add_parser(
         "launch",

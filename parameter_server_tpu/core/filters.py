@@ -57,6 +57,14 @@ class Filter:
     def decode(self, msg: Message) -> Message:
         return msg
 
+    def on_send_failed(self, msg: Message) -> None:
+        """Hook: the wire write for an encoded ``msg`` did not happen.
+
+        Filters that committed per-link state during encode must roll it
+        back here, or the link state desynchronizes from what the receiver
+        actually saw.
+        """
+
 
 class KeyCachingFilter(Filter):
     """Drop the key array when the receiver has seen it (hash match).
@@ -104,6 +112,13 @@ class KeyCachingFilter(Filter):
             else:
                 self._send_cache[link] = (h, msg.keys)
         return out
+
+    def on_send_failed(self, msg: Message) -> None:
+        # The receiver never saw this frame: drop the link's send cache so
+        # the next send re-ships the key list instead of a hash the peer
+        # cannot resolve (which would poison every later hit on this set).
+        with self._lock:
+            self._send_cache.pop(self._link(msg), None)
 
     def decode(self, msg: Message) -> Message:
         h = msg.task.payload.get("key_hash")
@@ -271,6 +286,10 @@ class FilterChain:
         for f in reversed(self.filters):
             msg = f.decode(msg)
         return msg
+
+    def on_send_failed(self, msg: Message) -> None:
+        for f in self.filters:
+            f.on_send_failed(msg)
 
     def stateless_subchain(self) -> "FilterChain":
         """The per-link-state-free filters, SAME instances (shared counters).

@@ -242,8 +242,14 @@ class TcpVan(Van):
                     (msg.sender, msg.recver), threading.Lock()
                 )
             with ll:
+                orig = msg
                 msg = self.filter_chain.encode(msg)
-                return self._send_wire(serialize_message(msg), addr)
+                ok = self._send_wire(serialize_message(msg), addr)
+                if not ok:
+                    # the receiver never saw this frame — stateful filters
+                    # (key caching) must roll back or the link poisons
+                    self.filter_chain.on_send_failed(orig)
+                return ok
         return self._send_wire(serialize_message(msg), addr)
 
     def _send_via_peer_conn(self, msg: Message) -> bool:

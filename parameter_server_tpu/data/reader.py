@@ -21,14 +21,21 @@ from typing import Callable, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from parameter_server_tpu.data import fs
 from parameter_server_tpu.data import text as text_lib
 
 CHUNK_BYTES = 8 << 20
 
 
 def _read_chunks(path: str, chunk_bytes: int) -> Iterator[bytes]:
-    """Yield line-aligned byte chunks of a text file."""
-    with open(path, "rb") as f:
+    """Yield line-aligned byte chunks of a text file.
+
+    ``path`` may be any :mod:`parameter_server_tpu.data.fs` url — local,
+    ``.gz``, or a remote ``psfs://`` shard — so every reader feeds from the
+    cluster file service with no call-site changes (reference ``file.h``
+    HDFS role).
+    """
+    with fs.open_stream(path) as f:
         carry = b""
         while True:
             block = f.read(chunk_bytes)
@@ -67,9 +74,10 @@ class SlotReader:
             os.makedirs(cache_dir, exist_ok=True)
 
     def _file_tag(self, path: str) -> str:
-        st = os.stat(path)
+        st = fs.stat(path)  # works for local AND psfs:// shard urls
+        ident = path if "://" in path else os.path.abspath(path)
         return hashlib.sha1(
-            f"{os.path.abspath(path)}:{st.st_size}:{st.st_mtime_ns}:"
+            f"{ident}:{st.size}:{st.mtime_ns}:"
             f"{self.chunk_bytes}".encode()
         ).hexdigest()[:16]
 

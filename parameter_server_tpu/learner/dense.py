@@ -292,9 +292,7 @@ class ChunkedAsyncDenseLearner:
         chain = getattr(kv.post.van, "filter_chain", None)
         if chain is None:
             return None
-        out = sum(
-            f.bytes_out for f in chain.filters if isinstance(f, CompressingFilter)
-        )
+        _bytes_in, out = chain.compressed_bytes()
         return out / 1e6 if out else None
 
     def run(

@@ -110,3 +110,31 @@ def test_filter_chain_end_to_end_through_van():
         assert chain.filters[0].hits >= 1
     finally:
         van.close()
+
+
+def test_key_cache_rolls_back_on_send_failure():
+    """A failed wire write must invalidate the link's send cache: otherwise
+    the next send hash-hits, ships keys=None, and the receiver (which never
+    saw the keys) raises a cache miss — poisoning the link until the key
+    set changes."""
+    import numpy as np
+
+    from parameter_server_tpu.core.filters import FilterChain, KeyCachingFilter
+    from parameter_server_tpu.core.messages import Message, Task, TaskKind
+
+    chain = FilterChain([KeyCachingFilter()])
+    keys = np.arange(8, dtype=np.int32)
+
+    def msg():
+        return Message(
+            task=Task(TaskKind.PULL, "kv", payload={}),
+            sender="W0", recver="S0", keys=keys,
+        )
+
+    assert chain.encode(msg()).keys is not None  # first send ships keys
+    chain.on_send_failed(msg())  # ...but the socket write failed
+    again = chain.encode(msg())
+    assert again.keys is not None  # MUST re-ship, not hash-hit
+    # receiver sees it, so a later send may legitimately hit
+    chain.decode(again)
+    assert chain.encode(msg()).keys is None
