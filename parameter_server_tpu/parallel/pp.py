@@ -1,0 +1,267 @@
+"""Pipeline parallelism: GPipe-style microbatch pipeline over a mesh axis.
+
+The one parallelism strategy the reference lacks that SURVEY.md §2 deferred
+("later-stage option via shard_map stages").  TPU-native formulation — no
+per-stage processes, no RPC: the layer stack is split into ``n_stages``
+contiguous stages whose weights shard over a ``pp`` mesh axis; microbatch
+activations flow stage-to-stage with nearest-neighbor ``lax.ppermute`` over
+ICI (the same ring the scaling-book pipeline recipe uses).  The whole
+pipeline — all ticks, all stages — is ONE jit-compiled ``lax.scan``, so
+XLA overlaps each tick's compute with the permute, and reverse-mode AD
+through the scan + ppermute yields the backward pipeline automatically
+(no hand-scheduled 1F1B needed for correctness).
+
+Schedule: classic GPipe fill/drain.  With S stages and M microbatches the
+scan runs ``M + S - 1`` ticks; stage 0 injects microbatch ``t`` at tick
+``t``, stage ``S-1`` emits microbatch ``t-S+1``.  Devices idle during
+fill/drain (the usual GPipe bubble, fraction ``(S-1)/(M+S-1)``) — raise M
+to amortize.
+
+Composable with DP: put ``pp`` beside a ``data`` axis in the mesh and
+shard the microbatch dimension of the inputs over ``data``; XLA inserts
+the gradient psum across ``data`` exactly as in the other trainers.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+PP_AXIS = "pp"
+
+
+def pipeline_apply(
+    stage_fn: Callable,
+    stage_params,
+    x_micro: jax.Array,
+    *,
+    axis_name: str = PP_AXIS,
+):
+    """Run microbatches through the stage pipeline.  Call inside shard_map.
+
+    ``stage_params``: THIS device's stage weights (any pytree).
+    ``x_micro``: [n_micro, ...activation...] — the full microbatch stack
+    (replicated along ``pp``; only stage 0 reads it).
+    Returns [n_micro, ...activation...]; rows are the final-stage outputs
+    on the LAST stage and zeros elsewhere — reduce with
+    :func:`last_stage_value` or consume on-stage.
+
+    The activation shape must be stage-invariant (true for transformer
+    blocks), because one buffer flows around the ring.
+    """
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    n_micro = x_micro.shape[0]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    zero = jnp.zeros_like(x_micro[0])
+    # constants must be device-varying to ride the ring loop carry
+    recv0 = jax.lax.pcast(zero, (axis_name,), to="varying")
+    out0 = jax.lax.pcast(jnp.zeros_like(x_micro), (axis_name,), to="varying")
+
+    def tick(carry, t):
+        recv, out_buf = carry
+        mb = jnp.clip(t, 0, n_micro - 1)
+        inject = jax.lax.dynamic_index_in_dim(x_micro, mb, keepdims=False)
+        x_in = jnp.where(idx == 0, inject, recv)
+        y = stage_fn(stage_params, x_in)
+        # the LAST stage finishes microbatch t-(n-1) at tick t
+        out_idx = jnp.clip(t - (n - 1), 0, n_micro - 1)
+        emit = jnp.logical_and(idx == n - 1, t >= n - 1)
+        current = jax.lax.dynamic_index_in_dim(out_buf, out_idx, keepdims=False)
+        out_buf = jax.lax.dynamic_update_index_in_dim(
+            out_buf, jnp.where(emit, y, current), out_idx, 0
+        )
+        recv = jax.lax.ppermute(y, axis_name, perm)
+        return (recv, out_buf), None
+
+    (_, out_buf), _ = jax.lax.scan(
+        tick, (recv0, out0), jnp.arange(n_micro + n - 1)
+    )
+    return out_buf
+
+
+def last_stage_value(value: jax.Array, *, axis_name: str = PP_AXIS) -> jax.Array:
+    """Replicate a value held by the last pp stage (zeros elsewhere)."""
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    return jax.lax.psum(
+        jnp.where(idx == n - 1, value, jnp.zeros_like(value)), axis_name
+    )
+
+
+def stack_stage_params(per_stage_params) -> object:
+    """Stack a list of per-stage pytrees along a new leading stage axis."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage_params)
+
+
+def stage_sharding(mesh: Mesh, tree) -> object:
+    """Shard stage-stacked params: leading axis over ``pp``, rest unsharded."""
+    def spec(leaf):
+        return NamedSharding(mesh, P(PP_AXIS, *(None,) * (leaf.ndim - 1)))
+
+    return jax.tree.map(spec, tree)
+
+
+class PipelinedLMTrainer:
+    """Causal-LM trainer with the transformer body pipelined over ``pp``.
+
+    Embedding and LM head are replicated (they are the small matmuls next
+    to the body at depth); the block stack splits into ``pp`` stages of
+    ``n_layers / pp`` blocks each.  One jit step = embed -> microbatch
+    pipeline (shard_map over ``pp``) -> head/loss on the last stage ->
+    adamw update; the loss and all gradients flow back through the scanned
+    pipeline by reverse-mode AD.
+    """
+
+    def __init__(
+        self,
+        cfg,
+        mesh: Mesh,
+        *,
+        n_micro: int = 4,
+        learning_rate: float = 1e-3,
+        seed: int = 0,
+    ) -> None:
+        import optax
+
+        from parameter_server_tpu.models import transformer as tfm
+
+        if PP_AXIS not in mesh.axis_names:
+            raise ValueError(f"mesh must carry a {PP_AXIS!r} axis, got {mesh.axis_names}")
+        n_stages = mesh.shape[PP_AXIS]
+        if cfg.n_layers % n_stages:
+            raise ValueError(
+                f"n_layers {cfg.n_layers} % pp stages {n_stages} != 0"
+            )
+        self.cfg = cfg
+        self.mesh = mesh
+        self.n_micro = n_micro
+        self.n_stages = n_stages
+        per_stage = cfg.n_layers // n_stages
+
+        # one flax module = one stage (per_stage sequential blocks)
+        stage_cfg_layers = per_stage
+
+        class Stage(tfm.nn.Module):  # type: ignore[name-defined]
+            @tfm.nn.compact
+            def __call__(self, x):
+                positions = jnp.arange(x.shape[1])[None, :]
+                for _ in range(stage_cfg_layers):
+                    x = tfm.Block(cfg)(x, positions)
+                return x
+
+        self.stage_module = Stage()
+        key = jax.random.PRNGKey(seed)
+        keys = jax.random.split(key, n_stages + 2)
+        x0 = jnp.zeros((1, 8, cfg.d_model), jnp.float32)
+        per_stage_params = [
+            self.stage_module.init(keys[s], x0)["params"] for s in range(n_stages)
+        ]
+        stacked = stack_stage_params(per_stage_params)
+        self.stage_params = jax.device_put(stacked, stage_sharding(mesh, stacked))
+
+        emb_key, head_key = keys[-2], keys[-1]
+        repl = NamedSharding(mesh, P())
+        self.embed = jax.device_put(
+            (jax.random.normal(emb_key, (cfg.vocab_size, cfg.d_model)) * 0.02
+             ).astype(jnp.float32),
+            repl,
+        )
+        self.head = jax.device_put(
+            (jax.random.normal(head_key, (cfg.d_model, cfg.vocab_size)) * 0.02
+             ).astype(jnp.float32),
+            repl,
+        )
+        self.tx = optax.adamw(learning_rate)
+        params0 = {"stages": self.stage_params, "embed": self.embed, "head": self.head}
+        # init INSIDE jit with the Adam moments CONSTRAINED to the params'
+        # shardings (mu/nu for the stage stack stay pp-sharded; replicating
+        # them would materialize 2x the full stack per device — the exact
+        # OOM pipeline parallelism exists to avoid)
+        param_shardings = jax.tree.map(lambda a: a.sharding, params0)
+
+        def _init_opt(p):
+            return optax.tree_map_params(
+                self.tx,
+                lambda leaf, sh: jax.lax.with_sharding_constraint(leaf, sh),
+                self.tx.init(p),
+                param_shardings,
+            )
+
+        with mesh:
+            self.opt_state = jax.jit(_init_opt)(params0)
+
+        stage_module, tx, axis = self.stage_module, self.tx, PP_AXIS
+
+        def stage_fn(stage_params_local, x):
+            # shard_map hands the local slice with a leading length-1 stage
+            # axis; peel it for the module
+            local = jax.tree.map(lambda a: a[0], stage_params_local)
+            return stage_module.apply({"params": local}, x)
+
+        def loss_from(params, tokens_micro):
+            # tokens_micro: [n_micro, mb, seq] int32 (replicated over pp)
+            x = jnp.take(params["embed"], tokens_micro, axis=0)
+
+            def body(stages, x_micro, tokens_ref):
+                out = pipeline_apply(stage_fn, stages, x_micro, axis_name=axis)
+                logits = jnp.einsum("mbsd,dv->mbsv", out, params["head"])
+                # per-microbatch causal loss, valid on the last stage only
+                losses = jax.vmap(tfm.causal_lm_loss)(logits, tokens_ref)
+                return last_stage_value(jnp.mean(losses), axis_name=axis)
+
+            shard = jax.shard_map(
+                body,
+                mesh=self.mesh,
+                in_specs=(
+                    jax.tree.map(lambda _: P(axis), params["stages"]),
+                    P(),
+                    P(),
+                ),
+                out_specs=P(),
+            )
+            return shard(params["stages"], x, tokens_micro)
+
+        def step_fn(params, opt_state, tokens_micro):
+            loss, grads = jax.value_and_grad(loss_from)(params, tokens_micro)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        self._step = jax.jit(step_fn, donate_argnums=(0, 1))
+        self._loss = jax.jit(loss_from)
+
+    def _params(self):
+        return {
+            "stages": self.stage_params,
+            "embed": self.embed,
+            "head": self.head,
+        }
+
+    def _micro(self, tokens: np.ndarray) -> np.ndarray:
+        tokens = np.asarray(tokens)
+        if tokens.shape[0] % self.n_micro:
+            raise ValueError(
+                f"batch {tokens.shape[0]} % n_micro {self.n_micro} != 0"
+            )
+        return tokens.reshape(
+            self.n_micro, tokens.shape[0] // self.n_micro, tokens.shape[1]
+        ).astype(np.int32)
+
+    def step(self, tokens: np.ndarray) -> float:
+        """tokens [B, S] -> loss; B must split into n_micro microbatches."""
+        micro = self._micro(tokens)
+        params, self.opt_state, loss = self._step(
+            self._params(), self.opt_state, jnp.asarray(micro)
+        )
+        self.stage_params = params["stages"]
+        self.embed = params["embed"]
+        self.head = params["head"]
+        return float(loss)
+
+    def loss(self, tokens: np.ndarray) -> float:
+        return float(self._loss(self._params(), jnp.asarray(self._micro(tokens))))
