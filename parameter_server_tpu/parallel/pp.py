@@ -137,6 +137,14 @@ class PipelinedLMTrainer:
             raise ValueError(
                 f"n_layers {cfg.n_layers} % pp stages {n_stages} != 0"
             )
+        if cfg.positional != "rotary":
+            # learned positional embeddings are a stage-0-only parameter and
+            # would break the uniform per-stage weight stacking; rotary is
+            # positionless state (computed per block from indices)
+            raise ValueError(
+                "PipelinedLMTrainer requires cfg.positional == 'rotary'; "
+                f"got {cfg.positional!r}"
+            )
         self.cfg = cfg
         self.mesh = mesh
         self.n_micro = n_micro
@@ -156,15 +164,27 @@ class PipelinedLMTrainer:
 
         self.stage_module = Stage()
         key = jax.random.PRNGKey(seed)
-        keys = jax.random.split(key, n_stages + 2)
+        keys = jax.random.split(key, n_stages + 3)
         x0 = jnp.zeros((1, 8, cfg.d_model), jnp.float32)
-        per_stage_params = [
-            self.stage_module.init(keys[s], x0)["params"] for s in range(n_stages)
-        ]
-        stacked = stack_stage_params(per_stage_params)
-        self.stage_params = jax.device_put(stacked, stage_sharding(mesh, stacked))
+        # init the stacked stage weights INSIDE jit with pp-sharded outputs:
+        # each stage materializes directly on its own device — an eager
+        # init + stack would hold the FULL layer stack on device 0, the
+        # exact allocation pipeline parallelism exists to avoid
+        shapes = jax.eval_shape(
+            lambda k: jax.vmap(
+                lambda kk: self.stage_module.init(kk, x0)["params"]
+            )(k),
+            keys[:n_stages],
+        )
+        with mesh:
+            self.stage_params = jax.jit(
+                lambda k: jax.vmap(
+                    lambda kk: self.stage_module.init(kk, x0)["params"]
+                )(k),
+                out_shardings=stage_sharding(mesh, shapes),
+            )(keys[:n_stages])
 
-        emb_key, head_key = keys[-2], keys[-1]
+        emb_key, head_key, norm_key = keys[-3], keys[-2], keys[-1]
         repl = NamedSharding(mesh, P())
         self.embed = jax.device_put(
             (jax.random.normal(emb_key, (cfg.vocab_size, cfg.d_model)) * 0.02
@@ -176,8 +196,21 @@ class PipelinedLMTrainer:
              ).astype(jnp.float32),
             repl,
         )
+        # final norm lives with the head OUTSIDE the pipeline (replicated):
+        # the canonical body (models/transformer._apply_body) normalizes the
+        # residual stream after the block stack; omitting it here would make
+        # PP train a subtly different model than the other trainers
+        self.norm_module = tfm.Norm(cfg.norm)
+        self.norm = jax.device_put(
+            self.norm_module.init(norm_key, x0)["params"], repl
+        )
         self.tx = optax.adamw(learning_rate)
-        params0 = {"stages": self.stage_params, "embed": self.embed, "head": self.head}
+        params0 = {
+            "stages": self.stage_params,
+            "embed": self.embed,
+            "head": self.head,
+            "norm": self.norm,
+        }
         # init INSIDE jit with the Adam moments CONSTRAINED to the params'
         # shardings (mu/nu for the stage stack stay pp-sharded; replicating
         # them would materialize 2x the full stack per device — the exact
@@ -196,6 +229,7 @@ class PipelinedLMTrainer:
             self.opt_state = jax.jit(_init_opt)(params0)
 
         stage_module, tx, axis = self.stage_module, self.tx, PP_AXIS
+        norm_module = self.norm_module
 
         def stage_fn(stage_params_local, x):
             # shard_map hands the local slice with a leading length-1 stage
@@ -209,6 +243,7 @@ class PipelinedLMTrainer:
 
             def body(stages, x_micro, tokens_ref):
                 out = pipeline_apply(stage_fn, stages, x_micro, axis_name=axis)
+                out = norm_module.apply({"params": params["norm"]}, out)
                 logits = jnp.einsum("mbsd,dv->mbsv", out, params["head"])
                 # per-microbatch causal loss, valid on the last stage only
                 losses = jax.vmap(tfm.causal_lm_loss)(logits, tokens_ref)
@@ -240,6 +275,7 @@ class PipelinedLMTrainer:
             "stages": self.stage_params,
             "embed": self.embed,
             "head": self.head,
+            "norm": self.norm,
         }
 
     def _micro(self, tokens: np.ndarray) -> np.ndarray:
@@ -261,6 +297,7 @@ class PipelinedLMTrainer:
         self.stage_params = params["stages"]
         self.embed = params["embed"]
         self.head = params["head"]
+        self.norm = params["norm"]
         return float(loss)
 
     def loss(self, tokens: np.ndarray) -> float:

@@ -41,12 +41,14 @@ def _sequential_loss(trainer, tokens):
     stages_host = jax.device_get(trainer.stage_params)
     embed = jax.device_get(trainer.embed)
     head = jax.device_get(trainer.head)
+    norm = jax.device_get(trainer.norm)
     losses = []
     for mb in micro:
         x = jnp.asarray(embed)[jnp.asarray(mb)]
         for s in range(trainer.n_stages):
             params_s = jax.tree.map(lambda a: jnp.asarray(a[s]), stages_host)
             x = trainer.stage_module.apply({"params": params_s}, x)
+        x = trainer.norm_module.apply({"params": jax.tree.map(jnp.asarray, norm)}, x)
         logits = jnp.einsum("bsd,dv->bsv", x, jnp.asarray(head))
         losses.append(tfm.causal_lm_loss(logits, jnp.asarray(mb)))
     return float(jnp.mean(jnp.asarray(losses)))
@@ -89,6 +91,10 @@ def test_pipeline_rejects_bad_shapes():
     cfg = tfm.tiny_config(causal=True, n_layers=2)  # 2 layers, 4 stages
     with pytest.raises(ValueError, match="n_layers"):
         PipelinedLMTrainer(cfg, _pp_mesh(4), n_micro=2)
+    # learned positional embeddings are stage-0-only state: unsupported
+    bert_like = tfm.tiny_config(causal=False, n_layers=2)
+    with pytest.raises(ValueError, match="rotary"):
+        PipelinedLMTrainer(bert_like, _pp_mesh(2), n_micro=2)
     mesh = _pp_mesh(2)
     trainer = PipelinedLMTrainer(cfg, mesh, n_micro=3)
     with pytest.raises(ValueError, match="n_micro"):
@@ -116,6 +122,7 @@ def test_pipeline_gradients_match_sequential():
             for s in range(trainer.n_stages):
                 ps = jax.tree.map(lambda a: a[s], p["stages"])
                 x = trainer.stage_module.apply({"params": ps}, x)
+            x = trainer.norm_module.apply({"params": p["norm"]}, x)
             logits = jnp.einsum("bsd,dv->bsv", x, p["head"])
             losses.append(tfm.causal_lm_loss(logits, mb))
         return jnp.mean(jnp.asarray(losses))
