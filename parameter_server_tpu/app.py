@@ -153,12 +153,16 @@ def _make_batch_fn(data: DataConfig):
         )
         return stream.next_batch
     if data.kind in ("libsvm", "criteo"):
+        from parameter_server_tpu.data import fs
         from parameter_server_tpu.data.reader import StreamReader
 
         if not data.path:
             raise ValueError(f"data.kind={data.kind!r} requires data.path")
+        # the path may be a glob and/or a psfs:// url — shard expansion and
+        # remote streaming both go through the fs layer (file.h/HDFS role)
+        files = fs.list_files(data.path) or [data.path]
         reader = StreamReader(
-            [data.path], data.batch_size, format=data.kind, epochs=None
+            files, data.batch_size, format=data.kind, epochs=None
         )
         it = iter(reader)
 

@@ -130,6 +130,19 @@ class HybridLMTrainer:
             return params, opt_state, loss, g_emb
 
         self._step = jax.jit(step_fn, donate_argnums=(0, 1))
+        #: body parameter count for the dashboard's MFU column (6ND rule:
+        #: fwd+bwd train FLOPs ~ 6 x params x tokens; set per step since the
+        #: sequence length rides the batch)
+        self._n_body_params = sum(
+            int(np.prod(p.shape)) for p in jax.tree.leaves(self.params)
+        )
+        # the numerator counts FLOPs executed across the WHOLE mesh, so the
+        # denominator must be the mesh's aggregate peak — one chip's peak
+        # would report an 8-chip run at up to 800% MFU
+        if self.dashboard.peak_flops <= 0.0:
+            self.dashboard.peak_flops = (
+                metrics_lib._auto_peak_flops() * self.mesh.devices.size
+            )
 
     # -- the hybrid hot path -------------------------------------------------
     def step(
@@ -200,6 +213,10 @@ class HybridLMTrainer:
         with self.tracer.span("hybrid.loss_sync"):
             loss_f = float(loss)
         emb_mb = tokens.size * self.cfg.d_model * 4 * 2 / 1e6  # pull + push
+        # one example = one sequence: 6 x body params x seq tokens
+        self.dashboard.flops_per_example = (
+            6.0 * self._n_body_params * tokens.shape[1]
+        )
         self.dashboard.record(
             self.step_count,
             loss_f,

@@ -238,3 +238,30 @@ def test_hybrid_prefetch_hides_pull_latency():
     prefetched_wait = run(prefetch=True)
     assert sync_wait > delay * 0.9  # the synthetic RTT is actually visible
     assert prefetched_wait < 0.5 * sync_wait, (sync_wait, prefetched_wait)
+
+
+def test_hybrid_dashboard_reports_mfu():
+    """The hybrid trainer's dashboard rows carry MFU (6ND model FLOPs)."""
+    import io
+    import json as json_lib
+
+    from parameter_server_tpu.utils import metrics as metrics_lib
+
+    cfg = tfm.tiny_config(causal=True, tie_embeddings=False)
+    mesh = mesh_lib.make_mesh((1, 1), devices=jax.devices()[:1])
+    van = LoopbackVan()
+    try:
+        _servers, worker = _hybrid_cluster(van, cfg)
+        sink = io.StringIO()
+        tr = hybrid.HybridLMTrainer(
+            cfg, mesh, worker,
+            dashboard=metrics_lib.Dashboard(jsonl=sink, print_every=0),
+        )
+        rng = np.random.default_rng(1)
+        tr.step(_tokens(cfg, rng))
+        tr.drain()
+        row = json_lib.loads(sink.getvalue().splitlines()[0])
+        assert row["mfu_pct"] > 0
+        assert row["emb_plane_mb"] > 0
+    finally:
+        van.close()
