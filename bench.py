@@ -489,6 +489,10 @@ def run_hybrid() -> tuple[dict, str]:
     tokens_per_sec = B * S * steps / dt
     emb_mb = B * S * cfg.d_model * 4 * 2 / 1e6  # pull + push per step
     hidden = max(0.0, 1.0 - pre_wait / max(sync_wait, 1e-9))
+    from parameter_server_tpu.utils.metrics import _auto_peak_flops
+
+    n_body = tr.n_body_params  # the trainer's own 6ND numerator
+    mfu = 6.0 * n_body * tokens_per_sec / _auto_peak_flops()
     record = {
         "metric": "hybrid_lm_step_time",
         "value": round(ms_step, 2),
@@ -496,6 +500,8 @@ def run_hybrid() -> tuple[dict, str]:
         "vs_baseline": None,
         "backend": backend,
         "tokens_per_sec": round(tokens_per_sec, 1),
+        "body_params": n_body,
+        "mfu_pct": round(mfu * 100, 3),
         "emb_plane_mb_step": round(emb_mb, 2),
         "pull_wait_prefetched_ms": round(pre_wait * 1e3, 3),
         "pull_wait_sync_ms": round(sync_wait * 1e3, 3),
@@ -664,10 +670,36 @@ _ANCHOR_END = "<!-- BENCH-ANCHOR:END -->"
 
 
 def record_anchor(record: dict, diag: str) -> None:
-    """Write a TPU measurement into BASELINE.md's anchor section."""
+    """Write a TPU measurement into BASELINE.md's anchor section.
+
+    Keeps a "Best" row across runs (the tunneled dev chip's interference
+    variance means the latest run is often not the most representative of
+    what the chip can do) alongside the latest measurement.
+    """
+    import re
+
     stamp = time.strftime("%Y-%m-%d %H:%M:%S UTC", time.gmtime())
+    prior_best = 0.0
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BASELINE.md")
+    try:
+        with open(path) as f:
+            text = f.read()
+        if _ANCHOR_BEGIN in text and _ANCHOR_END in text:
+            # bound the search to the anchor section: a "| Best |" cell in
+            # any LATER table must not leak in as this metric's best
+            section = text.split(_ANCHOR_BEGIN, 1)[1].split(_ANCHOR_END, 1)[0]
+            m = re.search(r"\| Best \| ([0-9,.]+) ", section)
+            if m:
+                prior_best = float(m.group(1).replace(",", ""))
+    except (OSError, ValueError):
+        pass
+    best_v = max(prior_best, float(record["value"]))
+    best_ratio = round(best_v / ANCHOR_EXAMPLES_PER_SEC, 4)
     body = (
-        f"\n| Measured | {record['value']:,} {record['unit']} | "
+        f"\n| Best | {best_v:,} {record['unit']} | "
+        f"{best_ratio}x the provisional anchor "
+        f"({ANCHOR_EXAMPLES_PER_SEC:,.0f}) | |\n"
+        f"| Latest | {record['value']:,} {record['unit']} | "
         f"backend={record['backend']} rows=2^22 batch={BATCH} nnz={NNZ} "
         f"block={record.get('block', BLOCK)} | {stamp} |\n"
         f"| vs anchor ({ANCHOR_EXAMPLES_PER_SEC:,.0f}) | "

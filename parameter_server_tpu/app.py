@@ -159,8 +159,14 @@ def _make_batch_fn(data: DataConfig):
         if not data.path:
             raise ValueError(f"data.kind={data.kind!r} requires data.path")
         # the path may be a glob and/or a psfs:// url — shard expansion and
-        # remote streaming both go through the fs layer (file.h/HDFS role)
-        files = fs.list_files(data.path) or [data.path]
+        # remote streaming both go through the fs layer (file.h/HDFS role).
+        # An empty expansion is a config error NOW, not a FileNotFoundError
+        # three layers deep at the first batch.
+        files = fs.list_files(data.path)
+        if not files:
+            raise FileNotFoundError(
+                f"data.path {data.path!r} matched no files"
+            )
         reader = StreamReader(
             files, data.batch_size, format=data.kind, epochs=None
         )

@@ -130,10 +130,11 @@ class HybridLMTrainer:
             return params, opt_state, loss, g_emb
 
         self._step = jax.jit(step_fn, donate_argnums=(0, 1))
-        #: body parameter count for the dashboard's MFU column (6ND rule:
-        #: fwd+bwd train FLOPs ~ 6 x params x tokens; set per step since the
-        #: sequence length rides the batch)
-        self._n_body_params = sum(
+        #: body parameter count for the MFU column (6ND rule: fwd+bwd train
+        #: FLOPs ~ 6 x params x tokens; set per step since the sequence
+        #: length rides the batch).  Public: bench --hybrid reuses it so the
+        #: two MFU computations cannot drift.
+        self.n_body_params = sum(
             int(np.prod(p.shape)) for p in jax.tree.leaves(self.params)
         )
         # the numerator counts FLOPs executed across the WHOLE mesh, so the
@@ -215,7 +216,7 @@ class HybridLMTrainer:
         emb_mb = tokens.size * self.cfg.d_model * 4 * 2 / 1e6  # pull + push
         # one example = one sequence: 6 x body params x seq tokens
         self.dashboard.flops_per_example = (
-            6.0 * self._n_body_params * tokens.shape[1]
+            6.0 * self.n_body_params * tokens.shape[1]
         )
         self.dashboard.record(
             self.step_count,
