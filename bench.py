@@ -489,10 +489,10 @@ def run_hybrid() -> tuple[dict, str]:
     tokens_per_sec = B * S * steps / dt
     emb_mb = B * S * cfg.d_model * 4 * 2 / 1e6  # pull + push per step
     hidden = max(0.0, 1.0 - pre_wait / max(sync_wait, 1e-9))
-    from parameter_server_tpu.utils.metrics import _auto_peak_flops
-
-    n_body = tr.n_body_params  # the trainer's own 6ND numerator
-    mfu = 6.0 * n_body * tokens_per_sec / _auto_peak_flops()
+    n_body = tr.n_body_params  # the trainer's own 6ND numerator...
+    # ...and the trainer's own denominator (mesh-aggregate peak), so bench
+    # and dashboard MFU agree even if run_hybrid's mesh grows
+    mfu = 6.0 * n_body * tokens_per_sec / tr.dashboard.peak_flops
     record = {
         "metric": "hybrid_lm_step_time",
         "value": round(ms_step, 2),

@@ -161,12 +161,24 @@ def _make_batch_fn(data: DataConfig):
         # the path may be a glob and/or a psfs:// url — shard expansion and
         # remote streaming both go through the fs layer (file.h/HDFS role).
         # An empty expansion is a config error NOW, not a FileNotFoundError
-        # three layers deep at the first batch.
+        # three layers deep at the first batch — unless the "glob" is really
+        # a literal filename containing metacharacters (day[1].csv) that
+        # exists on disk, which must keep working.
+        import os as os_lib
+
         files = fs.list_files(data.path)
         if not files:
-            raise FileNotFoundError(
-                f"data.path {data.path!r} matched no files"
+            literal = (
+                data.path[len("file://") :]
+                if data.path.startswith("file://")
+                else data.path
             )
+            if not data.path.startswith("psfs://") and os_lib.path.exists(literal):
+                files = [data.path]
+            else:
+                raise FileNotFoundError(
+                    f"data.path {data.path!r} matched no files"
+                )
         reader = StreamReader(
             files, data.batch_size, format=data.kind, epochs=None
         )

@@ -230,6 +230,13 @@ class PipelinedLMTrainer:
 
         stage_module, tx, axis = self.stage_module, self.tx, PP_AXIS
         norm_module = self.norm_module
+        #: DP composition: a "data" axis beside "pp" shards the microbatch
+        #: rows; every device still runs the same pipeline schedule and the
+        #: loss pmean over "data" (whose grads transpose to the psum) is the
+        #: usual DP gradient allreduce.
+        from parameter_server_tpu.parallel.mesh import DATA_AXIS
+
+        data_axis = DATA_AXIS if DATA_AXIS in mesh.axis_names else None
 
         def stage_fn(stage_params_local, x):
             # shard_map hands the local slice with a leading length-1 stage
@@ -247,15 +254,22 @@ class PipelinedLMTrainer:
                 logits = jnp.einsum("mbsd,dv->mbsv", out, params["head"])
                 # per-microbatch causal loss, valid on the last stage only
                 losses = jax.vmap(tfm.causal_lm_loss)(logits, tokens_ref)
-                return last_stage_value(jnp.mean(losses), axis_name=axis)
+                loss = last_stage_value(jnp.mean(losses), axis_name=axis)
+                if data_axis is not None:  # DP: mean over batch shards
+                    loss = jax.lax.pmean(loss, data_axis)
+                return loss
 
+            x_spec = (
+                P(None, data_axis, None, None) if data_axis else P()
+            )
+            tok_spec = P(None, data_axis, None) if data_axis else P()
             shard = jax.shard_map(
                 body,
                 mesh=self.mesh,
                 in_specs=(
                     jax.tree.map(lambda _: P(axis), params["stages"]),
-                    P(),
-                    P(),
+                    x_spec,
+                    tok_spec,
                 ),
                 out_specs=P(),
             )
