@@ -26,10 +26,12 @@ anchor section (between the ANCHOR markers) so the first-build-milestone
 anchor lives in the doc, not just in this file.
 """
 
+import functools
 import json
 import os
 import subprocess
 import sys
+import threading
 import time
 
 import numpy as np
@@ -42,9 +44,8 @@ ANCHOR_EXAMPLES_PER_SEC = 713398.0
 ROWS = 1 << 22  # 4.2M-row weight table (fits any chip; Criteo-1TB hashed)
 NNZ = 39  # criteo categorical slots
 BATCH = 16384
-BLOCK = 8  # steps per dispatch (scan length)
+BLOCK = 32  # steps per dispatch (scan length) — FIXED headline config (r4)
 WARMUP_BLOCKS = 2
-MEASURE_BLOCKS = 8
 PROBE_TIMEOUT_S = 75.0
 
 #: Peak dense f32 FLOP/s per chip for the MFU denominator.  TPU v5e ≈ 197
@@ -52,9 +53,59 @@ PROBE_TIMEOUT_S = 75.0
 #: an honest "how far from peak" attribution, not a target.
 PEAK_FLOPS = {"tpu": 197e12, "cpu": 1e11}
 
+#: Peak HBM bandwidth for the roofline sanity assert (VERDICT r3 #1): any
+#: effective-bandwidth claim above this is a harness artifact, not physics.
+#: v5e HBM ≈ 819 GB/s.  The CPU number is deliberately generous (DDR burst);
+#: the assert only gates on TPU where the model is meaningful.
+PEAK_HBM_GBPS = {"tpu": 819.0, "cpu": 200.0}
+
+
+_EMIT_ONCE = threading.Lock()
+_EMITTED = False
+
 
 def _emit(obj: dict) -> None:
-    print(json.dumps(obj), flush=True)
+    """Print the one-and-only JSON result line (idempotent: the watchdog
+    and the main path race only when the device wakes up exactly as the
+    watchdog fires; whoever wins, exactly one line is printed)."""
+    global _EMITTED
+    with _EMIT_ONCE:
+        if _EMITTED:
+            return
+        _EMITTED = True
+        print(json.dumps(obj), flush=True)
+
+
+def _start_watchdog(metric: str, unit: str, default_s: float = 540.0) -> None:
+    """Emit an error JSON and hard-exit if the run wedges (tunnel stall).
+
+    The probe bounds backend INIT hangs, but the axon tunnel can also stall
+    MID-RUN (observed this round: a measurement loop blocked in tcp recv
+    for 8+ minutes).  A daemon thread keeps the 'stdout always carries one
+    JSON line' contract under that failure too.  ``PS_BENCH_WATCHDOG_S``
+    (default ``default_s``) bounds the whole bench.
+    """
+    seconds = float(os.environ.get("PS_BENCH_WATCHDOG_S", default_s))
+    if seconds <= 0:
+        return
+
+    def run() -> None:
+        time.sleep(seconds)
+        _emit(
+            {
+                "metric": metric,
+                "value": 0.0,
+                "unit": unit,
+                "vs_baseline": None,
+                "error": (
+                    f"bench watchdog: no result after {seconds:.0f}s "
+                    "(device/tunnel stall mid-run)"
+                ),
+            }
+        )
+        os._exit(3)
+
+    threading.Thread(target=run, daemon=True, name="bench-watchdog").start()
 
 
 def _probe_once(
@@ -152,8 +203,40 @@ def lr_hbm_bytes_per_example(nnz: int) -> float:
     return 5 * 4 * nnz
 
 
+def _quantiles(xs: list[float]) -> tuple[float, float, float]:
+    """(q25, median, q75) of a sample."""
+    a = np.asarray(sorted(xs), dtype=np.float64)
+    return (
+        float(np.quantile(a, 0.25)),
+        float(np.quantile(a, 0.5)),
+        float(np.quantile(a, 0.75)),
+    )
+
+
 def run_bench() -> tuple[dict, str]:
-    """Measure; returns (json_record, stderr_diagnostics)."""
+    """Measure; returns (json_record, stderr_diagnostics).
+
+    Methodology (VERDICT r3 #1 — replaces the r1–r3 best-of-configs pass):
+
+    - ONE fixed config (block=32, the r3 winner; rows/batch/nnz module
+      constants).  No config selection inside the timed region.
+    - **Pipelined headline**: N repeats (default 10 on TPU), each a timed
+      window of >= PS_BENCH_WINDOW_S seconds (default 5; calibrated block
+      count), dispatching `step_block` back-to-back so H2D overlaps device
+      compute exactly as the production loop does.  Headline value =
+      **median** of the repeats; IQR and every repeat ride the JSON
+      (``agg: "median-of-N"``); best is a separate field, never the value.
+    - **Host-fed attributed passes**: the same work with a barrier after
+      each phase (assemble -> H2D -> device), timestamps around each phase
+      of the SAME loop, so sum(phases) == window by construction (asserted
+      to 10%).  The host-fed examples/sec is a first-class second metric —
+      it is the rate a reference-style worker that cannot overlap would see.
+    - **Roofline sanity**: the row-touch-model effective HBM bandwidth at
+      the headline rate must be <= the chip's HBM peak, and the headline
+      window must be >= the attributed device-only time for the same work
+      scaled by 0.5 (tunnel-variance tolerance).  Violations put an
+      ``error`` field in the record and block BASELINE.md recording.
+    """
     import jax
 
     from parameter_server_tpu.config import OptimizerConfig, TableConfig
@@ -161,6 +244,13 @@ def run_bench() -> tuple[dict, str]:
     from parameter_server_tpu.learner.sgd import LocalLRTrainer
 
     backend = jax.default_backend()
+    on_tpu = backend == "tpu"
+    window_s = float(
+        os.environ.get("PS_BENCH_WINDOW_S", 5.0 if on_tpu else 1.0)
+    )
+    repeats = max(1, int(os.environ.get("PS_BENCH_REPEATS", 10 if on_tpu else 3)))
+    fed_repeats = max(1, int(os.environ.get("PS_BENCH_FED_REPEATS", 3)))
+    pool_blocks = max(2, int(os.environ.get("PS_BENCH_POOL_BLOCKS", 8)))
 
     def assemble(batches):
         # keys stay at their raw width here: step_block owns the uint32 cast
@@ -171,109 +261,176 @@ def run_bench() -> tuple[dict, str]:
         labels = np.stack([b[1] for b in batches])
         return keys, labels
 
-    # The tunneled dev chip shows heavy interference variance, and the scan
-    # length trades per-dispatch overhead against pipeline depth — so the
-    # headline is the best of (block-size configs x repeats), each repeat a
-    # full timed pass.  Config and repeat count ride the diagnostics.
-    configs = [(BLOCK, MEASURE_BLOCKS), (32, max(MEASURE_BLOCKS // 4, 2))]
-    repeats = max(1, int(os.environ.get("PS_BENCH_REPEATS", 2)))
-    best = None  # (ex/s, block, meas, dt, losses, raw)
-    for blk, meas in configs:
-        cfg = TableConfig(
-            name="w",
-            rows=ROWS,
-            dim=1,
-            optimizer=OptimizerConfig(kind="adagrad", learning_rate=0.05),
-        )
-        trainer = LocalLRTrainer(cfg, mode="dense", device_hash=True)
-        data = SyntheticCTR(
-            key_space=1 << 26, nnz=NNZ, batch_size=BATCH, seed=0,
-            informative=0.1,
-        )
-        raw = [
-            [data.next_batch() for _ in range(blk)]
-            for _ in range(WARMUP_BLOCKS + meas)
-        ]
-        for batches in raw[:WARMUP_BLOCKS]:
-            trainer.step_block(*assemble(batches))
-        jax.block_until_ready(trainer.table.value)
-        for _ in range(repeats):
-            t0 = time.perf_counter()
-            losses = None
-            for batches in raw[WARMUP_BLOCKS:]:
-                losses = trainer.step_block(*assemble(batches))
-            jax.block_until_ready(losses)
-            d = time.perf_counter() - t0
-            eps = meas * blk * BATCH / d
-            if best is None or eps > best[0]:
-                best = (eps, blk, meas, d, losses, raw, trainer, cfg)
-    examples_per_sec, blk, meas, dt, losses, raw, trainer, cfg = best
-    n_examples = meas * blk * BATCH
-    measured_final_loss = float(np.asarray(losses)[-1])
+    cfg = TableConfig(
+        name="w",
+        rows=ROWS,
+        dim=1,
+        optimizer=OptimizerConfig(kind="adagrad", learning_rate=0.05),
+    )
+    trainer = LocalLRTrainer(cfg, mode="dense", device_hash=True)
+    data = SyntheticCTR(
+        key_space=1 << 26, nnz=NNZ, batch_size=BATCH, seed=0,
+        informative=0.1,
+    )
+    # Finite pool of DISTINCT blocks, cycled to fill each window (distinct
+    # inputs every dispatch inside a window; pool bounds host RAM).
+    pool = [
+        [data.next_batch() for _ in range(BLOCK)] for _ in range(pool_blocks)
+    ]
+    for batches in pool[:WARMUP_BLOCKS]:
+        trainer.step_block(*assemble(batches))
+    jax.block_until_ready(trainer.table.value)
 
-    # -- step-time attribution: host assemble / H2D / device compute --------
-    # host assemble share: re-run the untimed-device parts standalone.
-    # Keys are cast to uint32 HERE (validation already ran inside the timed
-    # loop's step_block) so the H2D bytes and the device-only loop match
-    # exactly what the real pipeline ships — 4 B/key, not raw 8 B/key.
-    t_h = time.perf_counter()
-    staged = [
-        (k.astype(np.uint32), y)
-        for k, y in (assemble(batches) for batches in raw[WARMUP_BLOCKS:])
-    ]
-    host_s = time.perf_counter() - t_h
-    # H2D share: timed device_put of the assembled blocks
-    t_x = time.perf_counter()
-    dev_blocks = [
-        (jax.device_put(k), jax.device_put(y)) for k, y in staged
-    ]
-    jax.block_until_ready([a for pair in dev_blocks for a in pair])
-    h2d_s = time.perf_counter() - t_x
-    h2d_bytes = sum(k.nbytes + y.nbytes for k, y in staged)
-    # device-only share: run the scan step on already-device-resident blocks
-    # (bypasses step_block's host-side key validation/conversion)
+    # calibrate: how many blocks make one >= window_s window?
+    t0 = time.perf_counter()
+    losses = trainer.step_block(*assemble(pool[0]))
+    jax.block_until_ready(losses)
+    per_block = max(time.perf_counter() - t0, 1e-6)
+    blocks_per_window = int(min(max(np.ceil(window_s / per_block), 2), 512))
+    n_examples = blocks_per_window * BLOCK * BATCH
+
+    # -- pipelined headline: back-to-back dispatch, barrier at window end --
+    pipelined: list[float] = []  # examples/sec per repeat
+    assemble_in_loop: list[float] = []  # host-assemble seconds per window
+    losses = None
+    for _ in range(repeats):
+        host_s = 0.0
+        t0 = time.perf_counter()
+        for i in range(blocks_per_window):
+            ta = time.perf_counter()
+            kb, yb = assemble(pool[i % pool_blocks])
+            host_s += time.perf_counter() - ta
+            losses = trainer.step_block(kb, yb)
+        jax.block_until_ready(losses)
+        d = time.perf_counter() - t0
+        pipelined.append(n_examples / d)
+        assemble_in_loop.append(host_s)
+    measured_final_loss = float(np.asarray(losses)[-1])
+    q1, med, q3 = _quantiles(pipelined)
+    med_dt = n_examples / med
+
+    # -- host-fed attributed passes: barrier after each phase of the SAME
+    # loop, so the phase sum IS the wall time (VERDICT r3 weak #1) --------
     from parameter_server_tpu.models import linear
 
-    t_d = time.perf_counter()
-    t = trainer.table
-    for k, y in dev_blocks:
-        (t.value, t.state, trainer.bias, trainer.bias_state, losses) = (
-            linear.dense_scan_train_step(
-                t.value, t.state, trainer.bias, trainer.bias_state,
-                k, y, trainer.optimizer, cfg.rows, trainer.localizer.seed,
+    fed: list[float] = []
+    phase_acc = {"assemble_s": 0.0, "h2d_s": 0.0, "device_s": 0.0}
+    fed_dt_total = 0.0
+    h2d_bytes_total = 0
+    for _ in range(fed_repeats):
+        t_start = time.perf_counter()
+        for i in range(blocks_per_window):
+            ta = time.perf_counter()
+            kb, yb = assemble(pool[i % pool_blocks])
+            kb32 = kb.astype(np.uint32)  # ships 4 B/key like step_block does
+            tb = time.perf_counter()
+            kd = jax.device_put(kb32)
+            yd = jax.device_put(yb)
+            jax.block_until_ready((kd, yd))
+            tc = time.perf_counter()
+            t = trainer.table
+            (t.value, t.state, trainer.bias, trainer.bias_state, losses) = (
+                linear.dense_scan_train_step(
+                    t.value, t.state, trainer.bias, trainer.bias_state,
+                    kd, yd, trainer.optimizer, cfg.rows,
+                    trainer.localizer.seed,
+                )
             )
-        )
-    jax.block_until_ready(losses)
-    device_s = time.perf_counter() - t_d
+            jax.block_until_ready(losses)
+            td = time.perf_counter()
+            phase_acc["assemble_s"] += tb - ta
+            phase_acc["h2d_s"] += tc - tb
+            phase_acc["device_s"] += td - tc
+            h2d_bytes_total += kb32.nbytes + yb.nbytes
+        dt_fed = time.perf_counter() - t_start
+        fed_dt_total += dt_fed
+        fed.append(n_examples / dt_fed)
+    _, fed_med, _ = _quantiles(fed)
+    phase_sum = sum(phase_acc.values())
+    phase_sum_ok = abs(phase_sum - fed_dt_total) <= 0.10 * fed_dt_total
+    h2d_gbps = h2d_bytes_total / max(phase_acc["h2d_s"], 1e-9) / 1e9
+    device_s_per_window = phase_acc["device_s"] / fed_repeats
 
     flops = lr_flops_per_example(NNZ) * n_examples
-    mfu = flops / dt / PEAK_FLOPS.get(backend, PEAK_FLOPS["cpu"])
-    hbm_gbps = lr_hbm_bytes_per_example(NNZ) * n_examples / dt / 1e9
+    mfu = flops / med_dt / PEAK_FLOPS.get(backend, PEAK_FLOPS["cpu"])
+    hbm_gbps = lr_hbm_bytes_per_example(NNZ) * n_examples / med_dt / 1e9
+    peak_hbm = PEAK_HBM_GBPS.get(backend, PEAK_HBM_GBPS["cpu"])
+    roofline_ok = hbm_gbps <= peak_hbm
+    # the pipelined window can hide host+H2D but cannot beat the device-only
+    # compute for identical work; 0.5x tolerance absorbs tunnel variance
+    device_floor_ok = med_dt >= 0.5 * device_s_per_window
+
+    errors = []
+    if not roofline_ok:
+        errors.append(
+            f"roofline violated: row-touch model implies {hbm_gbps:.0f} GB/s"
+            f" > {peak_hbm:.0f} GB/s peak"
+        )
+    if not phase_sum_ok:
+        errors.append(
+            f"attribution inconsistent: phase sum {phase_sum:.2f}s vs "
+            f"host-fed wall {fed_dt_total:.2f}s"
+        )
+    if not device_floor_ok:
+        errors.append(
+            f"headline window {med_dt:.2f}s < 0.5x device-only "
+            f"{device_s_per_window:.2f}s for identical work"
+        )
 
     record = {
         "metric": "criteo_sparse_lr_async_sgd_throughput",
-        "value": round(examples_per_sec, 1),
+        "value": round(med, 1),
         "unit": "examples/sec/chip",
         # the anchor is a TPU measurement: a CPU-fallback throughput divided
         # by it is not a speedup and must not read as one (VERDICT r2 weak #3)
         "vs_baseline": (
-            round(examples_per_sec / ANCHOR_EXAMPLES_PER_SEC, 4)
-            if backend == "tpu"
-            else None
+            round(med / ANCHOR_EXAMPLES_PER_SEC, 4) if on_tpu else None
         ),
         "backend": backend,
-        "block": blk,
-        "measure_blocks": meas,
+        "agg": f"median-of-{repeats}",
+        "repeats_eps": [round(x, 1) for x in pipelined],
+        "iqr_eps": [round(q1, 1), round(q3, 1)],
+        "best_eps": round(max(pipelined), 1),
+        "window_s": round(med_dt, 3),
+        "blocks_per_window": blocks_per_window,
+        "block": BLOCK,
+        "host_fed": {
+            "value": round(fed_med, 1),
+            "unit": "examples/sec/chip (assemble+H2D+device, no overlap)",
+            "agg": f"median-of-{fed_repeats}",
+            "repeats_eps": [round(x, 1) for x in fed],
+            "phases_s": {k: round(v, 3) for k, v in phase_acc.items()},
+            "phase_sum_s": round(phase_sum, 3),
+            "wall_s": round(fed_dt_total, 3),
+            "h2d_gbps": round(h2d_gbps, 3),
+        },
+        "consistency": {
+            "phase_sum_ok": phase_sum_ok,
+            "roofline_ok": roofline_ok,
+            "device_floor_ok": device_floor_ok,
+            "effective_hbm_gbps": round(hbm_gbps, 1),
+            "peak_hbm_gbps": peak_hbm,
+        },
     }
+    if errors:
+        record["error"] = "; ".join(errors)
     diag = (
-        f"backend={backend} blocks={meas}x{blk} batch={BATCH} "
-        f"nnz={NNZ} rows={ROWS} dt={dt:.3f}s "
+        f"backend={backend} block={BLOCK} batch={BATCH} nnz={NNZ} "
+        f"rows={ROWS} window={blocks_per_window} blocks "
+        f"({n_examples} examples, {med_dt:.2f}s at median) "
         f"final_loss={measured_final_loss:.4f}\n"
-        f"breakdown: host_assemble={host_s:.3f}s "
-        f"h2d={h2d_s:.3f}s ({h2d_bytes / max(h2d_s, 1e-9) / 1e9:.2f} GB/s, "
-        f"{h2d_bytes / 1e6:.1f} MB) device_steps={device_s:.3f}s\n"
-        f"mfu={mfu * 100:.3f}% (flops_model={flops / 1e9:.2f} GF over run) "
-        f"effective_hbm={hbm_gbps:.1f} GB/s (row-touch model)"
+        f"pipelined: median={med:,.0f} ex/s IQR=[{q1:,.0f}, {q3:,.0f}] "
+        f"best={max(pipelined):,.0f} over {repeats} repeats "
+        f"(in-loop host assemble {np.mean(assemble_in_loop):.2f}s/window)\n"
+        f"host-fed: median={fed_med:,.0f} ex/s; per-window phases "
+        f"assemble={phase_acc['assemble_s'] / fed_repeats:.2f}s "
+        f"h2d={phase_acc['h2d_s'] / fed_repeats:.2f}s ({h2d_gbps:.2f} GB/s) "
+        f"device={device_s_per_window:.2f}s "
+        f"[sum {phase_sum:.2f}s vs wall {fed_dt_total:.2f}s: "
+        f"{'OK' if phase_sum_ok else 'MISMATCH'}]\n"
+        f"mfu={mfu * 100:.3f}% (flops_model={flops / 1e9:.2f} GF/window) "
+        f"effective_hbm={hbm_gbps:.1f} GB/s (row-touch model, "
+        f"peak {peak_hbm:.0f}: {'OK' if roofline_ok else 'VIOLATION'})"
     )
     return record, diag
 
@@ -517,21 +674,449 @@ def run_hybrid() -> tuple[dict, str]:
 
 
 # ---------------------------------------------------------------------------
+# --llama8b: flagship feasibility — 8B memory table + embedding plane
+# ---------------------------------------------------------------------------
+
+
+def _feasibility_subprocess(
+    mesh, batch, seq, remat, loss_chunk, fsdp, scan=True
+) -> dict:
+    """Run the AOT memory analysis in a fresh CPU process (the 16-device
+    virtual topology must be fixed before jax initializes)."""
+    env = dict(os.environ)
+    root = os.path.dirname(os.path.abspath(__file__))
+    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=16"
+    cmd = [
+        sys.executable, "-m", "parameter_server_tpu.parallel.feasibility",
+        "--mesh", mesh, "--batch", str(batch), "--seq", str(seq),
+        "--loss-chunk", str(loss_chunk),
+        "--remat" if remat else "--no-remat",
+        "--fsdp", fsdp,
+        "--scan-blocks" if scan else "--no-scan-blocks",
+    ]
+    out = subprocess.run(
+        cmd, capture_output=True, text=True, env=env, timeout=1800
+    )
+    if out.returncode != 0:
+        return {"error": (out.stderr or "")[-300:]}
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def run_llama8b() -> tuple[dict, list[str]]:
+    """Flagship (config #5) feasibility: memory on v5e-16 + emb plane.
+
+    VERDICT r3 #3: (a) AOT-compile the REAL 8B body step over a simulated
+    16-device mesh and read per-device compiled memory from XLA, across the
+    fitting knobs (remat / chunked fused-head loss / FSDP); (b) bench the
+    PS embedding plane at the 8B shape (vocab 128k x d 4096) on the real
+    chip — bytes/step and pull/push rates.
+    """
+    import jax
+
+    backend = jax.default_backend()
+    lines = []
+    # -- (a) memory table (CPU-sim subprocesses; backend-independent) -------
+    grid = [
+        # (mesh, batch, seq, remat, loss_chunk, fsdp, scan_blocks)
+        ("2,8", 8, 2048, True, 512, "state", True),  # the fitting recipe
+        ("2,8", 8, 2048, True, 512, "none", True),  # moments replicated
+        ("2,8", 4, 2048, False, 0, "none", False),  # naive unrolled
+    ]
+    mem_rows = []
+    for mesh, batch, seq, remat, chunk, fsdp, scan in grid:
+        r = _feasibility_subprocess(
+            mesh, batch, seq, remat, chunk, fsdp, scan
+        )
+        r.update(mesh_cfg=mesh, batch=batch, seq=seq)
+        mem_rows.append(r)
+        if "error" in r:
+            lines.append(f"8b mem mesh={mesh} FAILED: {r['error'][:120]}")
+        else:
+            lines.append(
+                f"8b mem mesh={mesh} b={batch} remat={remat} chunk={chunk} "
+                f"fsdp={fsdp} scan={scan}: "
+                f"peak={r['peak_bytes'] / 1e9:.2f} GB/device "
+                f"fits_v5e={r['fits_v5e']}"
+            )
+
+    # -- (b) embedding plane at the 8B shape on the current backend ---------
+    from parameter_server_tpu.config import OptimizerConfig, TableConfig
+    from parameter_server_tpu.core.postoffice import Postoffice
+    from parameter_server_tpu.core.van import LoopbackVan
+    from parameter_server_tpu.kv.server import KVServer
+    from parameter_server_tpu.kv.worker import KVWorker
+    from parameter_server_tpu.utils.keys import IdentityLocalizer
+
+    VOCAB, D = 128_256, 4096
+    B, S, steps = 16, 2048, 6
+    cfgs = {
+        "emb": TableConfig(
+            name="emb", rows=VOCAB, dim=D,
+            optimizer=OptimizerConfig(kind="adagrad", learning_rate=0.05),
+        )
+    }
+    van = LoopbackVan()
+    try:
+        for s in range(2):
+            KVServer(
+                Postoffice(f"S{s}", van), cfgs, s, 2, device_replies=True
+            )
+        worker = KVWorker(
+            Postoffice("W0", van), cfgs, 2,
+            localizers={"emb": IdentityLocalizer(VOCAB)},
+        )
+        rng = np.random.default_rng(0)
+        # zipf-ish token draw (real token streams are heavy-tailed)
+        toks = [
+            (rng.zipf(1.2, size=(B, S)) % VOCAB).astype(np.int64)
+            for _ in range(steps + 1)
+        ]
+        # warmup (compile)
+        ts = worker.pull("emb", toks[0])
+        rows = worker.pull_result_device(ts, timeout=120)
+        g = rows.reshape(-1, D) * 0.01
+        worker.wait(worker.push_device("emb", toks[0].reshape(-1), g), 120)
+        import jax as _jax
+
+        _jax.block_until_ready(rows)
+        pull_ms, push_ms, uniq = [], [], []
+        t_all = time.perf_counter()
+        for i in range(1, steps + 1):
+            t0 = time.perf_counter()
+            ts = worker.pull("emb", toks[i])
+            rows = worker.pull_result_device(ts, timeout=120)
+            _jax.block_until_ready(rows)
+            pull_ms.append((time.perf_counter() - t0) * 1e3)
+            g = rows.reshape(-1, D) * 0.01
+            t0 = time.perf_counter()
+            pts = worker.push_device("emb", toks[i].reshape(-1), g)
+            if not worker.wait(pts, timeout=120):
+                raise TimeoutError("emb push not acked")
+            push_ms.append((time.perf_counter() - t0) * 1e3)
+            uniq.append(len(np.unique(toks[i])))
+        wall = time.perf_counter() - t_all
+        mean_uniq = float(np.mean(uniq))
+        row_mb = mean_uniq * D * 4 / 1e6
+        emb = {
+            "vocab": VOCAB, "d_model": D, "batch": B, "seq": S,
+            "pull_ms": round(float(np.median(pull_ms)), 1),
+            "push_ms": round(float(np.median(push_ms)), 1),
+            "unique_rows_per_step": round(mean_uniq, 0),
+            "unique_row_mb_per_step": round(row_mb, 1),
+            "tokens_per_sec": round(B * S * steps / wall, 1),
+            "backend": backend,
+        }
+        lines.append(
+            f"8b emb plane ({backend}): pull {emb['pull_ms']} ms, push "
+            f"{emb['push_ms']} ms, {emb['unique_rows_per_step']:.0f} unique "
+            f"rows ({row_mb:.0f} MB)/step, {emb['tokens_per_sec']:,.0f} tok/s"
+        )
+    finally:
+        van.close()
+
+    fits = [r for r in mem_rows if r.get("fits_v5e")]
+    record = {
+        "metric": "llama8b_fits_v5e16",
+        "value": 1.0 if fits else 0.0,
+        "unit": "1 = a measured config fits 16 GB/device (XLA memory analysis)",
+        "vs_baseline": None,
+        "backend": backend,
+        "memory_grid": mem_rows,
+        "emb_plane": emb,
+    }
+    return record, lines
+
+
+_L8B_BEGIN = "<!-- BENCH-LLAMA8B:BEGIN -->"
+_L8B_END = "<!-- BENCH-LLAMA8B:END -->"
+
+
+def record_llama8b(record: dict, lines: list[str]) -> None:
+    stamp = time.strftime("%Y-%m-%d %H:%M:%S UTC", time.gmtime())
+    rows_md = ""
+    for r in record["memory_grid"]:
+        if "error" in r:
+            rows_md += f"| {r.get('mesh_cfg')} | — | — | — | — | ERROR |\n"
+            continue
+        rows_md += (
+            f"| ({r['mesh_cfg']}) | {r['batch']}x{r['seq']} | "
+            f"remat={r['remat']} chunk={r['loss_chunk']} fsdp={r['fsdp']} | "
+            f"{r['argument_bytes'] / 1e9:.2f} | {r['temp_bytes'] / 1e9:.2f} | "
+            f"**{r['peak_bytes'] / 1e9:.2f} GB** "
+            f"{'FITS' if r['fits_v5e'] else 'OVER'} |\n"
+        )
+    emb = record["emb_plane"]
+    body = (
+        f"\n{stamp}.  Body = Llama-3-8B minus embeddings (7.50 B params, 32L "
+        "x 4096d x 14336ff, GQA 32/8 — TP capped at 8 by the KV heads), AOT "
+        "memory per device from XLA's own analysis of the full train step "
+        "(fwd+bwd+adamw) on a simulated (data, model) v5e-16 mesh:\n\n"
+        "| mesh | batch x seq | knobs | args GB | temps GB | peak/device |\n"
+        "|---|---|---|---|---|---|\n" + rows_md +
+        f"\nEmbedding plane at the 8B shape (vocab {emb['vocab']:,} x d "
+        f"{emb['d_model']}, PS-served, device-resident replies, backend "
+        f"`{emb['backend']}`): pull {emb['pull_ms']} ms + push "
+        f"{emb['push_ms']} ms per step of {emb['batch']}x{emb['seq']} "
+        f"zipf tokens = {emb['unique_rows_per_step']:.0f} unique rows "
+        f"({emb['unique_row_mb_per_step']} MB x2 directions), "
+        f"{emb['tokens_per_sec']:,.0f} tok/s through the plane alone.\n"
+    )
+    _splice_baseline(
+        _L8B_BEGIN,
+        _L8B_END,
+        body,
+        "## Llama-3-8B (config #5) feasibility "
+        "(auto-recorded by bench.py --llama8b)",
+    )
+
+
+def _write_criteo_file(path: str, rows: int, seed: int = 0) -> int:
+    """Synthesize a Criteo-format TSV (label, 13 ints, 26 hex cats)."""
+    rng = np.random.default_rng(seed)
+    chunk = 50_000
+    written = 0
+    with open(path, "w") as f:
+        while written < rows:
+            n = min(chunk, rows - written)
+            labels = rng.integers(0, 2, n)
+            dense = rng.integers(0, 1000, (n, 13))
+            cats = rng.integers(0, 1 << 32, (n, 26), dtype=np.uint64)
+            lines = []
+            for i in range(n):
+                lines.append(
+                    f"{labels[i]}\t"
+                    + "\t".join(str(x) for x in dense[i])
+                    + "\t"
+                    + "\t".join(format(x, "08x") for x in cats[i])
+                )
+            f.write("\n".join(lines) + "\n")
+            written += n
+    return os.path.getsize(path)
+
+
+def run_ingest() -> tuple[dict, list[str]]:
+    """Measure the full ingest chain against the chip's example demand.
+
+    VERDICT r3 #4: the chain (textparse.cc -> StreamReader -> psfs) existed
+    end to end with no measurement showing the host can feed the chip at the
+    claimed example rates.  This benches, per stage: raw native parse rate,
+    local StreamReader batch assembly, psfs-streamed StreamReader, and the
+    tail-filtered reader — each in examples/sec and MB/s — and divides the
+    chip's measured demand by the reader rate to report how many reader
+    hosts one chip needs.
+    """
+    import tempfile
+
+    from parameter_server_tpu.data import fs, text as text_lib
+    from parameter_server_tpu.data.reader import StreamReader
+    from parameter_server_tpu.data.tailfilter import TailFilteredStream
+
+    rows = int(os.environ.get("PS_INGEST_ROWS", 300_000))
+    batch = 16384
+    tmpdir = tempfile.mkdtemp(prefix="ps_ingest_")
+    path = os.path.join(tmpdir, "day0.tsv")
+    nbytes = _write_criteo_file(path, rows)
+    lines: list[str] = [
+        f"ingest rows={rows} file={nbytes / 1e6:.1f} MB batch={batch}"
+    ]
+    stages: dict = {}
+
+    def _rate(name: str, n_examples: int, n_bytes: int, dt: float) -> None:
+        stages[name] = {
+            "examples_per_sec": round(n_examples / dt, 1),
+            "mb_per_sec": round(n_bytes / dt / 1e6, 2),
+            "sec": round(dt, 3),
+        }
+        lines.append(
+            f"{name}: {n_examples / dt:,.0f} ex/s ({n_bytes / dt / 1e6:.1f} "
+            f"MB/s)"
+        )
+
+    # 1) raw native parse rate (the textparse.cc hot loop, all threads)
+    with open(path, "rb") as f:
+        raw = f.read()
+    text_lib.parse_criteo(raw[: 1 << 20])  # warm the library
+    t0 = time.perf_counter()
+    labels, _dense, _keys = text_lib.parse_criteo(raw)
+    dt = time.perf_counter() - t0
+    _rate("parse_native", labels.shape[0], nbytes, dt)
+
+    # 2) StreamReader over the local file (chunking + parse + batch carry)
+    t0 = time.perf_counter()
+    n = 0
+    for keys, _d, _l in StreamReader([path], batch, format="criteo", epochs=1):
+        n += keys.shape[0]
+    dt = time.perf_counter() - t0
+    _rate("stream_local", n, nbytes, dt)
+
+    # 3) StreamReader over psfs:// (remote shard service on loopback)
+    srv = fs.FileServer(tmpdir, port=0).start()
+    try:
+        url = f"{srv.url}/day0.tsv"
+        t0 = time.perf_counter()
+        n = 0
+        for keys, _d, _l in StreamReader(
+            [url], batch, format="criteo", epochs=1
+        ):
+            n += keys.shape[0]
+        dt = time.perf_counter() - t0
+        _rate("stream_psfs", n, nbytes, dt)
+    finally:
+        srv.stop()
+
+    # 4) tail-filtered reader (count-min on the production path)
+    it = iter(StreamReader([path], batch, format="criteo", epochs=1))
+
+    def batch_fn():
+        keys, _d, labels_ = next(it)
+        return keys, labels_
+
+    tail = TailFilteredStream(batch_fn, threshold=2)
+    t0 = time.perf_counter()
+    n = 0
+    try:
+        while True:
+            keys, _labels = tail()
+            n += keys.shape[0]
+    except StopIteration:
+        pass
+    dt = time.perf_counter() - t0
+    _rate("stream_tailfiltered", n, nbytes, dt)
+    stages["stream_tailfiltered"]["masked_fraction"] = round(
+        tail.masked_fraction, 4
+    )
+
+    # 5) chip demand: reader hosts needed per chip at measured device rates
+    demands = {"anchor_713k": ANCHOR_EXAMPLES_PER_SEC}
+    reader_eps = stages["stream_local"]["examples_per_sec"]
+    feed = {
+        k: round(v / reader_eps, 2) for k, v in demands.items()
+    }
+    lines.append(
+        "hosts-to-feed-one-chip (local reader): "
+        + ", ".join(f"{k}={v}" for k, v in feed.items())
+    )
+
+    import shutil
+
+    shutil.rmtree(tmpdir, ignore_errors=True)
+    record = {
+        "metric": "ingest_stream_local_examples_per_sec",
+        "value": reader_eps,
+        "unit": "examples/sec (host StreamReader, criteo format)",
+        "vs_baseline": None,
+        "stages": stages,
+        "readers_per_chip": feed,
+        "file_mb": round(nbytes / 1e6, 1),
+        "rows": rows,
+    }
+    return record, lines
+
+
+_INGEST_BEGIN = "<!-- BENCH-INGEST:BEGIN -->"
+_INGEST_END = "<!-- BENCH-INGEST:END -->"
+
+
+def record_ingest(record: dict, lines: list[str]) -> None:
+    stamp = time.strftime("%Y-%m-%d %H:%M:%S UTC", time.gmtime())
+    st = record["stages"]
+    rows_md = "".join(
+        f"| {name} | {s['examples_per_sec']:,} | {s['mb_per_sec']} |"
+        f" {s.get('masked_fraction', '')} |\n"
+        for name, s in st.items()
+    )
+    body = (
+        f"\n{stamp}; {record['file_mb']} MB synthetic Criteo TSV, "
+        f"{record['rows']:,} rows, batch 16384.\n\n"
+        "| stage | examples/s | MB/s | masked frac |\n|---|---|---|---|\n"
+        + rows_md
+        + f"\nReader hosts needed to feed ONE chip at measured device "
+        f"rates: {json.dumps(record['readers_per_chip'])} — the reference "
+        "ran 800 workers : 200 servers for the same reason (OSDI'14 §5.1 "
+        "[U]); a pod host feeds its chips from N parser threads / psfs "
+        "shards, so single-thread reader rate x threads is the host budget "
+        "to compare against examples/sec/chip x chips-per-host.\n"
+    )
+    _splice_baseline(
+        _INGEST_BEGIN,
+        _INGEST_END,
+        body,
+        "## Host ingest: parser / reader / psfs rates vs chip demand "
+        "(auto-recorded by bench.py --ingest)",
+    )
+
+
+_HYBRID_BEGIN = "<!-- BENCH-HYBRID:BEGIN -->"
+_HYBRID_END = "<!-- BENCH-HYBRID:END -->"
+
+
+def record_hybrid(record: dict, diag: str) -> None:
+    """Write the --hybrid measurement into BASELINE.md (VERDICT r3 weak #3:
+    a claimed measurement that isn't recorded anywhere is a claim)."""
+    stamp = time.strftime("%Y-%m-%d %H:%M:%S UTC", time.gmtime())
+    body = (
+        f"\nBackend `{record['backend']}`, {stamp}.  Config #5 shape "
+        f"{record['unit'].split('(', 1)[-1].rstrip(')')}:\n\n"
+        "| ms/step | tokens/s | MFU | emb plane MB/step | pull wait "
+        "prefetched | pull wait sync | hidden |\n"
+        "|---|---|---|---|---|---|---|\n"
+        f"| {record['value']} | {record.get('tokens_per_sec', 0):,} | "
+        f"{record.get('mfu_pct', 0)}% | "
+        f"{record.get('emb_plane_mb_step', 0)} | "
+        f"{record.get('pull_wait_prefetched_ms', 0)} ms | "
+        f"{record.get('pull_wait_sync_ms', 0)} ms | "
+        f"{record.get('pull_latency_hidden_pct', 0)}% |\n\n"
+        f"({diag})\n"
+    )
+    _splice_baseline(
+        _HYBRID_BEGIN,
+        _HYBRID_END,
+        body,
+        "## Hybrid config #5 step (auto-recorded by bench.py --hybrid)",
+    )
+
+
+# ---------------------------------------------------------------------------
 # --micro: gather / scatter-add kernel comparison (XLA vs Pallas)
 # ---------------------------------------------------------------------------
+
+
+def _distinct_ids(rng, rows_n: int, iters: int, batch: int) -> np.ndarray:
+    """``[iters, batch]`` int32 ids, no duplicates within an iteration and a
+    DIFFERENT id set every iteration (VERDICT r3 weak #2: timing 100
+    identical ops on identical inputs let result-shaped artifacts through).
+    Built from concatenated permutations so within-row uniqueness holds."""
+    need = iters * batch
+    chunks = []
+    got = 0
+    while got < need:
+        chunks.append(rng.permutation(rows_n))
+        got += rows_n
+    flat = np.concatenate(chunks)[:need]
+    return flat.reshape(iters, batch).astype(np.int32)
 
 
 def run_micro() -> tuple[dict, list[str]]:
     """Microbench the table hot ops over a (rows x dim x batch) grid.
 
-    Times jitted, donated, in-place ``gather_rows`` / ``scatter_add_rows``
-    under both impls on the current backend.  Pallas rows are only timed on
-    TPU (the interpreter is a correctness tool, not a perf path).  This is
-    the harness that settles SURVEY §7 hard part #2 — "the kernel that
-    determines examples/sec/chip" — by measurement instead of belief.
+    Times jitted ``gather_rows`` / ``scatter_add_rows`` under both impls on
+    the current backend.  Pallas rows are only timed on TPU (the interpreter
+    is a correctness tool, not a perf path).  This is the harness that
+    settles SURVEY §7 hard part #2 — "the kernel that determines
+    examples/sec/chip" — by measurement instead of belief.
+
+    r4 methodology (VERDICT r3 weak #2): the ``iters`` iterations run inside
+    ONE ``lax.scan`` with a data-dependent carry and per-iteration DISTINCT
+    ids, so iterations serialize on the device and dispatch overhead is out
+    of the measurement; and every effective-bandwidth claim is checked
+    against the chip's HBM roofline — a number above peak fails the bench
+    instead of getting recorded as fact.
     """
     import jax
     import jax.numpy as jnp
+    from jax import lax
 
     from parameter_server_tpu.ops import scatter
 
@@ -540,11 +1125,15 @@ def run_micro() -> tuple[dict, list[str]]:
     rng = np.random.default_rng(0)
     iters = int(os.environ.get("PS_MICRO_ITERS", 100))
     repeats = int(os.environ.get("PS_MICRO_REPEATS", 3))
+    peak_hbm = PEAK_HBM_GBPS.get(backend, PEAK_HBM_GBPS["cpu"])
     lines = [
-        f"micro backend={backend} iters={iters} best-of-{repeats} (us/op, "
-        "effective GB/s = touched row bytes / time; scatter RMW = 3 touches)"
+        f"micro backend={backend} iters={iters} (scan-serialized, distinct "
+        f"ids/iter) best-of-{repeats} (us/op, effective GB/s = touched row "
+        "bytes / time; scatter RMW = 3 touches; "
+        f"roofline {peak_hbm:.0f} GB/s)"
     ]
     results = []
+    roofline_violations = []
     grid = [
         (1 << 16, 128, 1024),
         (1 << 20, 128, 8192),
@@ -556,9 +1145,7 @@ def run_micro() -> tuple[dict, list[str]]:
         table = jnp.asarray(
             rng.normal(size=(rows_n + 1, dim)).astype(np.float32)
         )
-        ids = jnp.asarray(
-            rng.choice(rows_n, size=batch, replace=False).astype(np.int32)
-        )
+        ids_all = jnp.asarray(_distinct_ids(rng, rows_n, iters, batch))
         vals = jnp.asarray(rng.normal(size=(batch, dim)).astype(np.float32))
         row = {"rows": rows_n, "dim": dim, "batch": batch}
         for op in ("gather", "scatter_add"):
@@ -568,44 +1155,63 @@ def run_micro() -> tuple[dict, list[str]]:
                     continue
                 try:
                     if op == "gather":
-                        f = jax.jit(
-                            lambda t, i, _impl=impl: scatter.gather_rows(
-                                t, i, impl=_impl
-                            )
-                        )
-                        out = f(table, ids)
+
+                        @functools.partial(jax.jit, static_argnames=())
+                        def gather_n(t, ia, _impl=impl):
+                            def body(acc, ids):
+                                out = scatter.gather_rows(t, ids, impl=_impl)
+                                # scalar reduce keeps the scan output O(1)
+                                # and makes each iteration's result live
+                                return acc + out.sum(), None
+
+                            acc, _ = lax.scan(body, jnp.float32(0.0), ia)
+                            return acc
+
+                        out = gather_n(table, ids_all)
                         jax.block_until_ready(out)
-                        dt = None  # best-of-repeats: tunnel jitter swamps
-                        for _ in range(repeats):  # single-run timings
+                        dt = None
+                        for _ in range(repeats):
                             t0 = time.perf_counter()
-                            for _ in range(iters):
-                                out = f(table, ids)
+                            out = gather_n(table, ids_all)
                             jax.block_until_ready(out)
                             d = time.perf_counter() - t0
                             dt = d if dt is None else min(dt, d)
                         touched = batch * dim * 4 * 2  # read row + write out
                     else:
-                        f = jax.jit(
-                            lambda t, i, v, _impl=impl: scatter.scatter_add_rows(
-                                t, i, v, impl=_impl
-                            ),
-                            donate_argnums=(0,),
-                        )
+
+                        @functools.partial(jax.jit, donate_argnums=(0,))
+                        def scatter_n(t, ia, v, _impl=impl):
+                            def body(tt, ids):
+                                return (
+                                    scatter.scatter_add_rows(
+                                        tt, ids, v, impl=_impl
+                                    ),
+                                    None,
+                                )
+
+                            tt, _ = lax.scan(body, t, ia)
+                            return tt
+
                         t = jnp.array(table)  # private copy; donated through
-                        t = f(t, ids, vals)
+                        t = scatter_n(t, ids_all, vals)
                         jax.block_until_ready(t)
                         dt = None
                         for _ in range(repeats):
                             t0 = time.perf_counter()
-                            for _ in range(iters):
-                                t = f(t, ids, vals)
+                            t = scatter_n(t, ids_all, vals)
                             jax.block_until_ready(t)
                             d = time.perf_counter() - t0
                             dt = d if dt is None else min(dt, d)
                         touched = batch * dim * 4 * 3  # read row+vals, write
                     us = dt / iters * 1e6
+                    gbps = round(touched / (dt / iters) / 1e9, 2)
                     row[f"{op}_{impl}_us"] = round(us, 1)
-                    row[f"{op}_{impl}_gbps"] = round(touched / (dt / iters) / 1e9, 2)
+                    row[f"{op}_{impl}_gbps"] = gbps
+                    if on_tpu and gbps > peak_hbm:
+                        roofline_violations.append(
+                            f"{op}/{impl} rows={rows_n} dim={dim} "
+                            f"batch={batch}: {gbps} GB/s > {peak_hbm} peak"
+                        )
                 except Exception as e:  # noqa: BLE001 — record, keep going
                     row[f"{op}_{impl}_us"] = f"ERR:{type(e).__name__}"
         results.append(row)
@@ -623,8 +1229,14 @@ def run_micro() -> tuple[dict, list[str]]:
         "unit": "x (xla_us / pallas_us, >1 = pallas wins)",
         "vs_baseline": None,
         "backend": backend,
+        "peak_hbm_gbps": peak_hbm,
         "grid": results,
     }
+    if roofline_violations:
+        record["error"] = "roofline violated: " + "; ".join(
+            roofline_violations
+        )
+        lines.append("ROOFLINE VIOLATIONS: " + "; ".join(roofline_violations))
     return record, lines
 
 
@@ -695,13 +1307,24 @@ def record_anchor(record: dict, diag: str) -> None:
         pass
     best_v = max(prior_best, float(record["value"]))
     best_ratio = round(best_v / ANCHOR_EXAMPLES_PER_SEC, 4)
+    iqr = record.get("iqr_eps", [0, 0])
+    fed = record.get("host_fed", {})
     body = (
         f"\n| Best | {best_v:,} {record['unit']} | "
         f"{best_ratio}x the provisional anchor "
-        f"({ANCHOR_EXAMPLES_PER_SEC:,.0f}) | |\n"
-        f"| Latest | {record['value']:,} {record['unit']} | "
+        f"({ANCHOR_EXAMPLES_PER_SEC:,.0f}); medians across rounds, "
+        f"r1-r3 were best-of-N | |\n"
+        f"| Latest ({record.get('agg', '?')}) | "
+        f"{record['value']:,} {record['unit']} | "
+        f"IQR [{iqr[0]:,}, {iqr[1]:,}], best {record.get('best_eps', 0):,}; "
         f"backend={record['backend']} rows=2^22 batch={BATCH} nnz={NNZ} "
-        f"block={record.get('block', BLOCK)} | {stamp} |\n"
+        f"block={record.get('block', BLOCK)} "
+        f"window={record.get('window_s', '?')}s | {stamp} |\n"
+        f"| Host-fed ({fed.get('agg', '?')}) | "
+        f"{fed.get('value', 0):,} examples/sec/chip | "
+        f"assemble+H2D+device barriers, no overlap; phases "
+        f"{fed.get('phases_s', {})} h2d_bw={fed.get('h2d_gbps', '?')} GB/s | "
+        f"{stamp} |\n"
         f"| vs anchor ({ANCHOR_EXAMPLES_PER_SEC:,.0f}) | "
         f"{record['vs_baseline']}x | {diag.splitlines()[-1]} | |\n"
     )
@@ -718,6 +1341,64 @@ def main() -> None:
     micro = "--micro" in sys.argv[1:]
     hybrid_mode = "--hybrid" in sys.argv[1:]
     crossover_mode = "--crossover" in sys.argv[1:]
+    if "--llama8b" in sys.argv[1:]:
+        # three multi-minute XLA compiles ride inside this mode
+        _start_watchdog("llama8b_fits_v5e16", "bool", default_s=2400.0)
+        try:
+            record, lines = run_llama8b()
+        except Exception as e:  # noqa: BLE001 — the JSON line must still emit
+            _emit(
+                {
+                    "metric": "llama8b_fits_v5e16",
+                    "value": 0.0,
+                    "unit": "bool",
+                    "vs_baseline": None,
+                    "error": f"llama8b failed: {type(e).__name__}: {e}"[:500],
+                }
+            )
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
+            return
+        _emit(record)
+        print("\n".join(lines), file=sys.stderr)
+        record_llama8b(record, lines)
+        return
+    if "--ingest" in sys.argv[1:]:
+        # host-side only: no TPU probe, no jax on the hot path
+        _start_watchdog(
+            "ingest_stream_local_examples_per_sec", "examples/sec"
+        )
+        try:
+            record, lines = run_ingest()
+        except Exception as e:  # noqa: BLE001 — the JSON line must still emit
+            _emit(
+                {
+                    "metric": "ingest_stream_local_examples_per_sec",
+                    "value": 0.0,
+                    "unit": "examples/sec",
+                    "vs_baseline": None,
+                    "error": f"ingest failed: {type(e).__name__}: {e}"[:500],
+                }
+            )
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
+            return
+        _emit(record)
+        print("\n".join(lines), file=sys.stderr)
+        record_ingest(record, lines)
+        return
+    if micro:
+        _start_watchdog("micro_scatter_add_pallas_speedup_vs_xla", "x")
+    elif hybrid_mode:
+        _start_watchdog("hybrid_lm_step_time", "ms/step")
+    elif crossover_mode:
+        _start_watchdog("lr_rows_vs_dense_crossover", "log2(rows)")
+    else:
+        _start_watchdog(
+            "criteo_sparse_lr_async_sgd_throughput", "examples/sec/chip"
+        )
     ok, detail = probe_backend()
     if ok and not detail.startswith("tpu"):
         # init "succeeded" but onto a non-TPU default backend (plugin absent
@@ -787,6 +1468,8 @@ def main() -> None:
             record["error"] = error
         _emit(record)
         print(diag, file=sys.stderr)
+        if record.get("backend") == "tpu" and not record.get("error"):
+            record_hybrid(record, diag)
         return
     if micro:
         try:
@@ -806,10 +1489,12 @@ def main() -> None:
             traceback.print_exc(file=sys.stderr)
             return
         if error:
-            record["error"] = error
+            record["error"] = "; ".join(
+                filter(None, [record.get("error"), error])
+            )
         _emit(record)
         print("\n".join(lines), file=sys.stderr)
-        if record.get("backend") == "tpu" and not error:
+        if record.get("backend") == "tpu" and not record.get("error"):
             record_micro(record, lines)
         return
     try:
@@ -829,10 +1514,12 @@ def main() -> None:
         traceback.print_exc(file=sys.stderr)
         return
     if error:
-        record["error"] = error
+        record["error"] = "; ".join(
+            filter(None, [record.get("error"), error])
+        )
     _emit(record)
     print(diag, file=sys.stderr)
-    if record.get("backend") == "tpu" and not error:
+    if record.get("backend") == "tpu" and not record.get("error"):
         record_anchor(record, diag)
 
 
