@@ -247,8 +247,9 @@ class TcpVan(Van):
                 ok = self._send_wire(serialize_message(msg), addr)
                 if not ok:
                     # the receiver never saw this frame — stateful filters
-                    # (key caching) must roll back or the link poisons
-                    self.filter_chain.on_send_failed(orig)
+                    # (key caching) must roll back or the link poisons, and
+                    # byte counters must un-commit (ADVICE r3)
+                    self.filter_chain.on_send_failed(orig, msg)
                 return ok
         return self._send_wire(serialize_message(msg), addr)
 
@@ -266,6 +267,8 @@ class TcpVan(Van):
         # requester's full chain decodes them fine.  Pull replies are the
         # bulk of DCN bytes, so skipping them entirely (as before) forfeited
         # most of the compression win.
+        orig = msg
+        sub = None
         if self.filter_chain is not None:
             sub = self._stateless_chain
             if sub is None:
@@ -281,6 +284,11 @@ class TcpVan(Van):
                 self.dropped_messages += 1
                 if self._peer_conns.get(msg.recver) == conn:
                     self._peer_conns.pop(msg.recver, None)  # stale conn
+        if rc != 0 and sub is not None:
+            # un-commit codec byte counters for a frame that never hit the
+            # wire (same rollback as the routed path; pull replies are the
+            # bulk of DCN bytes, so this path overstated worst)
+            sub.on_send_failed(orig, msg)
         return rc == 0
 
     def _send_wire(self, data: bytes, addr: Tuple[str, int]) -> bool:

@@ -221,8 +221,17 @@ def run_job(
                     np_.savez(f, **arrays)
                 os.replace(tmp, _ckpt_path(ckpt_root, done))
             multihost_utils.sync_global_devices(f"ckpt{done}")
-        if die_after_step is not None and proc_id == die_proc and done == die_after_step:
-            os._exit(17)  # fault injection: hard kill mid-job
+        if (
+            die_after_step is not None
+            and (die_proc < 0 or proc_id == die_proc)
+            and done == die_after_step
+        ):
+            # fault injection: hard kill mid-job.  die_proc=-1 kills EVERY
+            # process at that step (a whole-job death): a single-proc kill
+            # leaves the survivors blocked in the next Gloo collective until
+            # the launch timeout, which is realistic but burns minutes of
+            # suite wall clock (ADVICE r3) — resume semantics are identical.
+            os._exit(17)
     return {"losses": losses, "data_digest": digest, "start_step": start_step}
 
 

@@ -111,9 +111,14 @@ def test_kill_and_rejoin_resumes_from_checkpoint(tmp_path):
     base = launch_spmd(**common)
     assert base["returncodes"] == [0, 0], base
 
-    # run with checkpoints every 2 steps; proc 1 dies hard after step 3
+    # run with checkpoints every 2 steps; the JOB dies hard after step 3
+    # (die_proc=-1: every process exits, so no survivor blocks in a Gloo
+    # collective until the launch timeout — ADVICE r3 wall-clock fix; a
+    # single-proc death has identical resume semantics, the survivor just
+    # hangs until killed)
+    broken = dict(common, timeout=90.0)
     broken = launch_spmd(
-        **common, ckpt_root=ckpt, ckpt_every=2, die_after_step=3, die_proc=1
+        **broken, ckpt_root=ckpt, ckpt_every=2, die_after_step=3, die_proc=-1
     )
     assert 17 in broken["returncodes"], broken  # the injected death
     import os

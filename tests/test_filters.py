@@ -112,6 +112,68 @@ def test_filter_chain_end_to_end_through_van():
         van.close()
 
 
+def test_add_noise_filter_perturbs_floats_only():
+    """The debug add_noise codec (reference src/filter/add_noise.h analogue):
+    float32 values get Gaussian noise at encode, ints pass untouched, decode
+    is the identity (noise is injected, not round-tripped)."""
+    from parameter_server_tpu.core.filters import AddNoiseFilter
+
+    f = AddNoiseFilter(sigma=0.1, seed=3)
+    vals = [np.zeros((256,), np.float32), np.arange(4, dtype=np.int64)]
+    enc = f.encode(_msg(values=vals))
+    assert not np.allclose(enc.values[0], 0.0)
+    assert np.abs(enc.values[0]).mean() < 0.5  # sigma-scale, not garbage
+    np.testing.assert_array_equal(enc.values[1], vals[1])
+    dec = f.decode(enc)
+    np.testing.assert_array_equal(dec.values[0], enc.values[0])
+
+
+def test_make_chain_specs():
+    from parameter_server_tpu.core.filters import (
+        AddNoiseFilter,
+        make_chain,
+    )
+
+    assert make_chain("none") is None
+    full = make_chain("full")
+    assert [type(f) for f in full.filters] == [
+        KeyCachingFilter, FixingFloatFilter, CompressingFilter,
+    ]
+    custom = make_chain("noise+zlib")
+    assert [type(f) for f in custom.filters] == [
+        AddNoiseFilter, CompressingFilter,
+    ]
+    with pytest.raises(ValueError):
+        make_chain("lz5")
+
+
+def test_chain_records_codec_overhead():
+    chain = FilterChain([FixingFloatFilter(), CompressingFilter()])
+    vals = [np.ones((512,), np.float32)]
+    for _ in range(3):
+        chain.decode(chain.encode(_msg(values=vals)))
+    oh = chain.overhead()
+    assert oh["encode_calls"] == 3 and oh["decode_calls"] == 3
+    assert oh["encode_us_per_msg"] > 0 and oh["decode_us_per_msg"] > 0
+
+
+def test_compressing_counters_roll_back_on_send_failure():
+    """bytes_in/bytes_out must not count frames that never hit the wire
+    (ADVICE r3): a failed send un-commits exactly the failed message's
+    contribution."""
+    f = CompressingFilter()
+    chain = FilterChain([f])
+    keys = np.arange(64, dtype=np.int64)
+    vals = [np.zeros((1024,), np.float32)]
+    ok = chain.encode(_msg(keys=keys, values=vals))
+    bi_ok, bo_ok = f.bytes_in, f.bytes_out
+    assert bi_ok > 0 and bo_ok > 0
+    failed = chain.encode(_msg(keys=keys, values=vals))
+    assert f.bytes_in == 2 * bi_ok
+    chain.on_send_failed(_msg(keys=keys, values=vals), failed)
+    assert (f.bytes_in, f.bytes_out) == (bi_ok, bo_ok)
+
+
 def test_key_cache_rolls_back_on_send_failure():
     """A failed wire write must invalidate the link's send cache: otherwise
     the next send hash-hits, ships keys=None, and the receiver (which never

@@ -126,17 +126,27 @@ def test_identity_localizer_contract():
 
 
 class _DelayVan(LoopbackVan):
-    """Loopback with synthetic per-reply latency (a fake DCN RTT)."""
+    """Loopback with synthetic per-reply latency (a fake DCN RTT).
+
+    The delay is CONCURRENT (timer-delivered), modeling wire latency: an
+    inline sleep would serialize every reply through the delivery path and
+    model a throughput limit instead, which no amount of prefetching can
+    hide (the r3 flakiness of the prefetch test, ADVICE r3)."""
 
     def __init__(self, reply_delay_s: float):
         super().__init__()
         self.reply_delay_s = reply_delay_s
 
     def send(self, msg):
-        import time as _time
+        import threading as _threading
 
         if not msg.is_request:  # delay replies: worker-visible Van latency
-            _time.sleep(self.reply_delay_s)
+            t = _threading.Timer(
+                self.reply_delay_s, lambda: LoopbackVan.send(self, msg)
+            )
+            t.daemon = True
+            t.start()
+            return True
         return super().send(msg)
 
 
@@ -206,12 +216,20 @@ def test_hybrid_pull_replies_are_device_arrays():
 
 def test_hybrid_prefetch_hides_pull_latency():
     """Announced next_tokens -> the pull's Van latency hides behind the
-    body step (>= 50% hidden vs the synchronous pull; VERDICT r2 #2)."""
+    body step (>= 50% hidden vs the synchronous pull; VERDICT r2 #2).
+
+    The tiny CPU body finishes in milliseconds, so the "long device step"
+    the prefetch hides behind is emulated with a sleep between steps —
+    exactly the pipeline position body compute occupies on hardware.  RTT
+    0.2 s against a 0.3 s step leaves a wide, GC-proof margin (ADVICE r3
+    medium: the old 50 ms margin was compile-noise flaky)."""
+    import time as _time
+
     from parameter_server_tpu.utils.trace import Tracer
 
     cfg = tfm.tiny_config(causal=True, tie_embeddings=False)
     mesh = mesh_lib.make_mesh((1, 1), devices=jax.devices()[:1])
-    delay = 0.05
+    delay = 0.2
 
     def run(prefetch: bool) -> float:
         van = _DelayVan(delay)
@@ -227,6 +245,8 @@ def test_hybrid_prefetch_hides_pull_latency():
             for i, b in enumerate(batches):
                 nxt = batches[i + 1] if prefetch and i + 1 < len(batches) else None
                 tr.step(b, next_tokens=nxt)
+                if i + 1 < len(batches):
+                    _time.sleep(0.3)  # the emulated long body step
             tr.drain()
             waits = [s[2] for s in tracer.spans("hybrid.pull_wait")]
             # skip step 0 (never prefetched)
@@ -236,6 +256,11 @@ def test_hybrid_prefetch_hides_pull_latency():
 
     sync_wait = run(prefetch=False)
     prefetched_wait = run(prefetch=True)
+    if prefetched_wait >= 0.5 * sync_wait:
+        # one retry before failing: a GC pause or neighboring-test compile
+        # can inflate a single measurement (ADVICE r3 medium)
+        sync_wait = run(prefetch=False)
+        prefetched_wait = run(prefetch=True)
     assert sync_wait > delay * 0.9  # the synthetic RTT is actually visible
     assert prefetched_wait < 0.5 * sync_wait, (sync_wait, prefetched_wait)
 

@@ -92,3 +92,25 @@ def test_launch_with_wire_filters():
     assert filtered["wire_sent"] < 0.7 * plain["wire_sent"], (
         filtered["wire_sent"], plain["wire_sent"],
     )
+    # the default-on stack is justified by measurement: per-message codec
+    # cost is recorded (VERDICT r3 #7) and small against a DCN RTT
+    oh = filtered["filter_overhead"]
+    assert oh is not None and oh["messages"] > 0, filtered
+    assert oh["encode_us_per_msg"] < 5000, oh  # codecs must stay sub-ms-ish
+    assert plain["filter_overhead"] is None  # no chain, no overhead entry
+
+
+def test_launch_default_filters_on():
+    """Launchers default to the full codec stack (VERDICT r3 #7): an
+    unconfigured launch reports filter overhead (chain present) and
+    converges."""
+    from parameter_server_tpu.launch import launch
+
+    result = launch(
+        num_workers=1, num_servers=1, steps=6, rows=1 << 10,
+        batch_size=64, run_timeout=240.0,
+    )
+    assert result["returncodes"] == [0] * 3, result
+    assert result["final_loss"] < result["first_loss"], result
+    assert result["filter_overhead"] is not None, result
+    assert result["filter_overhead"]["messages"] > 0
