@@ -66,11 +66,14 @@ class SpmdDenseTrainer:
         *,
         seed: int = 0,
         loss_fn=softmax_xent,
+        dashboard: Optional[metrics_lib.Dashboard] = None,
     ) -> None:
         self.model = model
         self.tx = tx
         self.mesh = mesh
         self.loss_fn = loss_fn
+        self.dashboard = dashboard or metrics_lib.Dashboard(print_every=0)
+        self.step_count = 0
         images, labels = example_batch
         variables = model.init(
             jax.random.PRNGKey(seed), jnp.asarray(images[:1]), train=False
@@ -104,6 +107,25 @@ class SpmdDenseTrainer:
             out_shardings=(repl, repl, repl, repl),
             donate_argnums=(0, 1, 2),
         )
+        # MFU wiring (VERDICT r3 weak #4): no clean closed form for conv
+        # nets, so the numerator is XLA's own per-conv FLOP count of the
+        # full train step (fwd+bwd+update), from the pre-compile HLO cost
+        # analysis of the example batch's shapes.
+        img = np.asarray(images)
+        lbl = np.asarray(labels)
+        step_flops = metrics_lib.lowered_flops(
+            self._step,
+            self.params,
+            self.extra,
+            self.opt_state,
+            jax.ShapeDtypeStruct(img.shape, jnp.float32),
+            jax.ShapeDtypeStruct(lbl.shape, jnp.int32),
+        )
+        self.dashboard.flops_per_example = step_flops / max(img.shape[0], 1)
+        if self.dashboard.peak_flops <= 0.0:
+            self.dashboard.peak_flops = metrics_lib.mesh_peak_flops(
+                mesh.devices.size
+            )
 
     def step(self, images: np.ndarray, labels: np.ndarray) -> float:
         images = jax.device_put(jnp.asarray(images), self._batch_img)
@@ -111,7 +133,12 @@ class SpmdDenseTrainer:
         self.params, self.extra, self.opt_state, loss = self._step(
             self.params, self.extra, self.opt_state, images, labels
         )
-        return float(loss)
+        loss_f = float(loss)
+        self.step_count += 1
+        self.dashboard.record(
+            self.step_count, loss_f, examples=int(images.shape[0])
+        )
+        return loss_f
 
     def eval_logits(self, images: np.ndarray) -> np.ndarray:
         out = self.model.apply(

@@ -56,6 +56,35 @@ def _auto_peak_flops() -> float:
         return 1e11
 
 
+def lowered_flops(jitfn, *args) -> float:
+    """XLA-reported FLOPs for ONE call of a jitted function.
+
+    Uses the pre-compile HLO cost analysis (``Lowered.cost_analysis``): no
+    compilation, no execution — cheap enough to run at trainer init.  This
+    is the generic MFU numerator for models without a clean closed form
+    (ResNet convs, DLRM interactions); transformers use the 6ND rule so the
+    number matches the convention papers report.  Returns 0.0 when the
+    backend can't produce an analysis (MFU column then stays off).
+    """
+    try:
+        ca = jitfn.lower(*args).cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        return float(ca.get("flops", 0.0))
+    except Exception:  # pragma: no cover — metrics must never crash training
+        return 0.0
+
+
+def mesh_peak_flops(n_devices: int) -> float:
+    """Aggregate peak FLOP/s of an ``n_devices`` mesh (MFU denominator).
+
+    The numerator counts FLOPs executed across the WHOLE mesh, so the
+    denominator must be the mesh's aggregate peak — one chip's peak would
+    report an 8-chip run at up to 800% MFU.
+    """
+    return _auto_peak_flops() * n_devices
+
+
 @dataclasses.dataclass
 class Dashboard:
     """Per-iteration progress table + JSONL sink.
