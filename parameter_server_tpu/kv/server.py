@@ -11,14 +11,16 @@ as the KVTable's jit-compiled device steps.
 
 from __future__ import annotations
 
-from typing import Dict
+import collections
+import zlib
+from typing import Dict, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from parameter_server_tpu.config import TableConfig
-from parameter_server_tpu.core.messages import Message, TaskKind
+from parameter_server_tpu.core.messages import Message, Task, TaskKind
 from parameter_server_tpu.core.postoffice import Customer, Postoffice
 from parameter_server_tpu.kv.partition import RangePartition
 from parameter_server_tpu.kv.table import KVTable
@@ -44,7 +46,20 @@ class KVServer(Customer):
         name: str = "kv",
         tracer: Tracer = NULL_TRACER,
         device_replies: bool = False,
+        replica: Optional[str] = None,
+        replica_sync: bool = False,
+        max_replica_lag: int = 8,
     ) -> None:
+        """``replica``: node id of a hot-standby KVServer holding the same
+        shard (chain replication of key ranges, the reference paper's §4.3
+        recovery [U]; VERDICT r3 #6).  Every applied push is forwarded to
+        it in apply order, so the standby's table+optimizer state tracks the
+        primary's exactly.  ``replica_sync=True`` = chain semantics: the
+        worker's ack only fires after the replica applied (ZERO update loss
+        on primary death); ``False`` = async forwarding with at most
+        ``max_replica_lag`` pushes in flight (bounded loss, no added push
+        latency).  On death, :func:`parameter_server_tpu.kv.replica.promote`
+        rebinds the standby under the primary's node id."""
         super().__init__(name, post)
         #: reply to pulls with device arrays instead of host numpy — the
         #: zero-copy mode for in-process (Loopback) planes where worker and
@@ -58,7 +73,11 @@ class KVServer(Customer):
             t: KVTable(
                 cfg,
                 rows=self.partitions[t].server_rows(server_index),
-                seed=hash((t, server_index)) & 0x7FFFFFFF,
+                # stable across OS processes (builtin str hash is salted per
+                # interpreter — servers spawned as separate processes would
+                # init different rows than an in-process cluster, breaking
+                # cross-deployment loss parity and restart determinism)
+                seed=zlib.crc32(f"{t}:{server_index}".encode()) & 0x7FFFFFFF,
             )
             for t, cfg in table_cfgs.items()
         }
@@ -66,6 +85,50 @@ class KVServer(Customer):
         self.pushes = 0
         self.pulls = 0
         self.tracer = tracer
+        # -- hot-replica forwarding channel ---------------------------------
+        self.replica = replica
+        self.replica_sync = replica_sync
+        self.max_replica_lag = max_replica_lag
+        self._fwd_inflight: collections.deque[int] = collections.deque()
+        if replica is not None:
+            # A DEDICATED endpoint for the primary's client role: waiting
+            # for replica acks on the server's own recv thread would
+            # deadlock (that thread must process the ack).  The forwarding
+            # Customer shares this server's customer name so the replica
+            # routes the forwarded pushes into its normal kv handler.
+            self._fwd_post = Postoffice(f"{post.node_id}.fw", post.van)
+            self._fwd = Customer(name, self._fwd_post)
+
+    def _forward_push(self, tname: str, msg: Message) -> None:
+        fwd = Message(
+            task=Task(TaskKind.PUSH, self._fwd.name, payload={"table": tname}),
+            recver=self.replica,
+            keys=np.asarray(msg.keys),
+            values=[np.asarray(msg.values[0])],
+        )
+        ts = self._fwd.submit([fwd])
+        if self.replica_sync:
+            if not self._fwd.wait(ts, timeout=60.0):
+                raise RuntimeError(
+                    f"replica {self.replica} did not ack push (sync chain)"
+                )
+            self._fwd.check(ts)
+        else:
+            self._fwd_inflight.append(ts)
+            while len(self._fwd_inflight) > self.max_replica_lag:
+                old = self._fwd_inflight.popleft()
+                if not self._fwd.wait(old, timeout=60.0):
+                    raise RuntimeError(
+                        f"replica {self.replica} lag exceeded "
+                        f"{self.max_replica_lag} and oldest ack timed out"
+                    )
+
+    def flush_replica(self, timeout: float = 60.0) -> None:
+        """Block until every async-forwarded push is acked by the replica."""
+        while self._fwd_inflight:
+            old = self._fwd_inflight.popleft()
+            if not self._fwd.wait(old, timeout):
+                raise RuntimeError(f"replica flush: ts={old} not acked")
 
     def handle_request(self, msg: Message) -> Message:
         if msg.task.kind == TaskKind.CONTROL:
@@ -98,6 +161,11 @@ class KVServer(Customer):
             with self.tracer.span("kv.server.push", table=tname):
                 table.push(ids, jnp.asarray(vals))
             self.pushes += 1
+            if self.replica is not None:
+                # forward AFTER the local apply, in apply order (this recv
+                # thread is the only writer), so the standby replays the
+                # identical update sequence
+                self._forward_push(tname, msg)
             return msg.reply()
         elif msg.task.kind == TaskKind.PULL:
             with self.tracer.span("kv.server.pull", table=tname):

@@ -1,0 +1,150 @@
+"""Hot-replica failover: the loss trajectory continues EXACTLY (VERDICT r3 #6).
+
+Contrast with ``learner/elastic.py``'s snapshot recovery, which rewinds to
+the last checkpoint and loses every update since: here a primary dies
+mid-run, its standby is promoted, and training continues as if nothing
+happened — asserted against an uninterrupted reference run, update for
+update.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from parameter_server_tpu.config import OptimizerConfig, TableConfig
+from parameter_server_tpu.core.postoffice import Postoffice
+from parameter_server_tpu.core.van import LoopbackVan
+from parameter_server_tpu.data.synthetic import SyntheticCTR
+from parameter_server_tpu.kv import replica as replica_lib
+from parameter_server_tpu.kv.server import KVServer
+from parameter_server_tpu.kv.worker import KVWorker
+from parameter_server_tpu.models import linear
+
+ROWS = 1 << 10
+NUM_SERVERS = 2
+STEPS = 12
+KILL_AFTER = 6
+
+
+def _table_cfgs():
+    return {
+        "w": TableConfig(
+            name="w",
+            rows=ROWS,
+            dim=1,
+            optimizer=OptimizerConfig(kind="adagrad", learning_rate=0.1),
+        )
+    }
+
+
+def _batches():
+    data = SyntheticCTR(key_space=4 * ROWS, nnz=8, batch_size=128, seed=3)
+    return [data.next_batch() for _ in range(STEPS)]
+
+
+def _train(worker: KVWorker, batches, on_step=None) -> list:
+    losses = []
+    for i, (keys, labels) in enumerate(batches):
+        w_pos = worker.pull_sync("w", keys, timeout=30)
+        g, _gb, loss = linear.grad_rows(jnp.asarray(w_pos), jnp.asarray(labels))
+        ts = worker.push("w", keys, np.asarray(g) / labels.shape[0])
+        assert worker.wait(ts, timeout=30)
+        losses.append(float(loss))
+        if on_step is not None:
+            on_step(i)
+    return losses
+
+
+def _reference_losses() -> list:
+    van = LoopbackVan()
+    try:
+        for s in range(NUM_SERVERS):
+            KVServer(Postoffice(f"S{s}", van), _table_cfgs(), s, NUM_SERVERS)
+        worker = KVWorker(Postoffice("W0", van), _table_cfgs(), NUM_SERVERS)
+        return _train(worker, _batches())
+    finally:
+        van.close()
+
+
+@pytest.mark.parametrize("sync", [True, False])
+def test_promoted_standby_continues_trajectory_exactly(sync):
+    """Kill primary S0 mid-run, promote its standby, keep training: every
+    loss matches the uninterrupted run — zero updates lost (sync chain), or
+    zero after an explicit flush (async with bounded lag)."""
+    reference = _reference_losses()
+
+    van = LoopbackVan()
+    try:
+        primaries, standbys = replica_lib.make_replicated_servers(
+            van, _table_cfgs(), NUM_SERVERS, sync=sync, max_lag=4
+        )
+        worker = KVWorker(Postoffice("W0", van), _table_cfgs(), NUM_SERVERS)
+
+        def on_step(i):
+            if i != KILL_AFTER - 1:
+                return
+            if not sync:
+                # async chain: bounded lag means forwards may still be in
+                # flight; a real primary death here would lose <= max_lag
+                # pushes.  Drain them to model the lag window being clear
+                # at the failure instant (the sync=True case needs nothing).
+                primaries[0].flush_replica()
+            van.unbind("S0")  # the primary process dies
+            replica_lib.promote(van, standbys[0], "S0")
+
+        losses = _train(worker, _batches(), on_step=on_step)
+    finally:
+        van.close()
+
+    # exact continuation: the standby replayed the identical update stream
+    # through the identical jit apply, from the identical init seed
+    np.testing.assert_allclose(losses, reference, rtol=1e-7, atol=0)
+
+
+def test_sync_chain_acks_after_replica_applied():
+    """replica_sync=True: when the worker's push ack fires, the standby has
+    already applied the update (pull the standby directly and compare)."""
+    van = LoopbackVan()
+    try:
+        primaries, standbys = replica_lib.make_replicated_servers(
+            van, _table_cfgs(), NUM_SERVERS, sync=True
+        )
+        worker = KVWorker(Postoffice("W0", van), _table_cfgs(), NUM_SERVERS)
+        keys, labels = _batches()[0]
+        w_pos = worker.pull_sync("w", keys, timeout=30)
+        g, _gb, _loss = linear.grad_rows(
+            jnp.asarray(w_pos), jnp.asarray(labels)
+        )
+        ts = worker.push("w", keys, np.asarray(g) / labels.shape[0])
+        assert worker.wait(ts, timeout=30)
+        # primary and standby tables are bitwise identical right now
+        for s in range(NUM_SERVERS):
+            np.testing.assert_array_equal(
+                np.asarray(primaries[s].tables["w"].value),
+                np.asarray(standbys[s].tables["w"].value),
+            )
+    finally:
+        van.close()
+
+
+def test_promotion_preserves_optimizer_state():
+    """AdaGrad accumulators ride the chain too: post-promotion updates use
+    the primary's accumulated state, not a fresh one (the silent-corruption
+    a values-only replica would cause)."""
+    van = LoopbackVan()
+    try:
+        primaries, standbys = replica_lib.make_replicated_servers(
+            van, _table_cfgs(), NUM_SERVERS, sync=True
+        )
+        worker = KVWorker(Postoffice("W0", van), _table_cfgs(), NUM_SERVERS)
+        batches = _batches()
+        _train(worker, batches[:4])
+        for s in range(NUM_SERVERS):
+            for k, st in primaries[s].tables["w"].state.items():
+                np.testing.assert_array_equal(
+                    np.asarray(st),
+                    np.asarray(standbys[s].tables["w"].state[k]),
+                )
+    finally:
+        van.close()
