@@ -27,6 +27,11 @@ def _cmd_run(args: argparse.Namespace) -> int:
     cfg = app_lib.load_config(args.config)
     if args.steps is not None:
         cfg = dataclasses.replace(cfg, steps=args.steps)
+    if getattr(args, "tail_filter", None) is not None:
+        cfg = dataclasses.replace(
+            cfg,
+            data=dataclasses.replace(cfg.data, tail_threshold=args.tail_filter),
+        )
     run = app_lib.create(cfg)
     result = run()
     losses = result.pop("losses", [])
@@ -100,6 +105,11 @@ def build_parser() -> argparse.ArgumentParser:
     run = sub.add_parser("run", help="run an app from a yaml/json config")
     run.add_argument("config")
     run.add_argument("--steps", type=int, default=None, help="override steps")
+    run.add_argument(
+        "--tail-filter", type=int, default=None, metavar="K",
+        help="override data.tail_threshold: mask keys seen < K times "
+        "(count-min tail filter on the input stream; 0 disables)",
+    )
     run.set_defaults(fn=_cmd_run)
 
     ev = sub.add_parser("eval", help="offline eval of a saved checkpoint")
@@ -144,9 +154,11 @@ def build_parser() -> argparse.ArgumentParser:
     la.add_argument("--batch-size", type=int, default=256)
     la.add_argument("--ckpt-root", default=None)
     la.add_argument(
-        "--filters", default="none",
-        choices=["none", "zlib", "int8", "int8+zlib", "full"],
-        help="wire filter stack on the TcpVan",
+        "--filters", default="full",
+        help="wire filter stack on the TcpVan: 'none' to opt out, 'full' "
+        "(=key_caching+int8+zlib, default — codecs ship on, as the "
+        "reference's do), or a '+'-joined subset of "
+        "{key_caching, int8, zlib, noise}",
     )
     la.set_defaults(fn=_cmd_launch)
 
@@ -163,7 +175,57 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--global-batch", type=int, default=256)
     sp.add_argument("--mesh-data", type=int, default=2)
     sp.set_defaults(fn=_cmd_launch_spmd)
+
+    hy = sub.add_parser(
+        "launch-hybrid",
+        help="dual-plane config #5: TcpVan embedding servers in their own "
+        "processes + a jax.distributed GSPMD body (CPU-sim by default)",
+    )
+    hy.add_argument("--num-body", type=int, default=2)
+    hy.add_argument("--cpu-devices", type=int, default=4)
+    hy.add_argument("--num-servers", type=int, default=2)
+    hy.add_argument("--steps", type=int, default=4)
+    hy.add_argument("--vocab", type=int, default=256)
+    hy.add_argument("--layers", type=int, default=2)
+    hy.add_argument("--heads", type=int, default=4)
+    hy.add_argument("--d-model", type=int, default=32)
+    hy.add_argument("--d-ff", type=int, default=64)
+    hy.add_argument("--seq", type=int, default=16)
+    hy.add_argument("--global-batch", type=int, default=8)
+    hy.add_argument("--emb-optimizer", default="adagrad")
+    hy.add_argument("--bsp", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="barrier the embedding plane every step (parity "
+                    "mode, the default — matches launch_hybrid()); "
+                    "--no-bsp enables the SSP overlap shape")
+    hy.add_argument("--max-delay", type=int, default=2)
+    hy.add_argument("--filters", default="full")
+    hy.set_defaults(fn=_cmd_launch_hybrid)
     return p
+
+
+def _cmd_launch_hybrid(args: argparse.Namespace) -> int:
+    from parameter_server_tpu.launch_hybrid import launch_hybrid
+
+    result = launch_hybrid(
+        num_body=args.num_body,
+        cpu_devices=args.cpu_devices,
+        num_servers=args.num_servers,
+        steps=args.steps,
+        vocab=args.vocab, layers=args.layers, heads=args.heads,
+        d_model=args.d_model, d_ff=args.d_ff, seq=args.seq,
+        global_batch=args.global_batch,
+        emb_optimizer=args.emb_optimizer,
+        bsp=args.bsp, max_delay=args.max_delay,
+        filters=args.filters,
+    )
+    losses = result["losses"].get(0, [])
+    print(json.dumps({
+        "returncodes": result["returncodes"],
+        "losses": losses,
+        "wire": result["wire"],
+    }))
+    return 0 if all(rc == 0 for rc in result["returncodes"]) else 1
 
 
 def _cmd_launch_spmd(args: argparse.Namespace) -> int:

@@ -118,6 +118,8 @@ class HybridLMTrainer:
             logits = body.apply({"params": params}, emb_in)
             return tfm.causal_lm_loss(logits, targets)
 
+        batch3 = self._batch3
+
         def step_fn(params, opt_state, emb_in, targets):
             # grads w.r.t. (params, emb_in): the emb_in gradient is what
             # flows back to the PS table as per-position row updates
@@ -125,6 +127,10 @@ class HybridLMTrainer:
                 params, emb_in, targets
             )
             g_params, g_emb = grads
+            # pin the embedding gradient to the batch sharding: each pod
+            # host then extracts exactly ITS batch rows from addressable
+            # shards for the local Van push (no cross-host gather)
+            g_emb = jax.lax.with_sharding_constraint(g_emb, batch3)
             updates, opt_state = tx.update(g_params, opt_state, params)
             params = optax.apply_updates(params, updates)
             return params, opt_state, loss, g_emb
@@ -141,9 +147,26 @@ class HybridLMTrainer:
         # denominator must be the mesh's aggregate peak — one chip's peak
         # would report an 8-chip run at up to 800% MFU
         if self.dashboard.peak_flops <= 0.0:
-            self.dashboard.peak_flops = (
-                metrics_lib._auto_peak_flops() * self.mesh.devices.size
+            self.dashboard.peak_flops = metrics_lib.mesh_peak_flops(
+                self.mesh.devices.size
             )
+
+    def _local_batch_rows(self, arr: jax.Array, sl: slice) -> np.ndarray:
+        """This process's rows ``[sl]`` of a batch-sharded global array.
+
+        Reads only addressable shards (no cross-host transfer): the array is
+        constrained to the batch sharding, whose data-axis layout is
+        process-major — a host's devices hold exactly its batch slice
+        (model-axis replicas repeat rows; idempotent overwrite).
+        """
+        shape = (sl.stop - sl.start,) + tuple(arr.shape[1:])
+        out = np.zeros(shape, np.float32)
+        for shard in arr.addressable_shards:
+            r = shard.index[0]
+            start = 0 if r.start is None else int(r.start)
+            stop = arr.shape[0] if r.stop is None else int(r.stop)
+            out[start - sl.start : stop - sl.start] = np.asarray(shard.data)
+        return out
 
     # -- the hybrid hot path -------------------------------------------------
     def step(
@@ -164,6 +187,24 @@ class HybridLMTrainer:
         hides ack latency (pulls get the same overlap pushes have).
         """
         tokens = np.asarray(tokens)
+        # Dual-plane pod shape (VERDICT r3 #2): when the GSPMD mesh spans OS
+        # processes, each process owns its local_batch_slice of the global
+        # batch end to end — pulls only its rows' embeddings over ITS Van
+        # connection, feeds them to its own devices
+        # (make_array_from_process_local_data), and later pushes only its
+        # rows' gradients.  Single-process runs keep the device-resident
+        # reply path.
+        multiproc = jax.process_count() > 1
+        if multiproc:
+            from parameter_server_tpu.parallel import distributed
+
+            sl = distributed.local_batch_slice(
+                jax.process_index(), jax.process_count(), tokens.shape[0]
+            )
+            tokens_feed = tokens[sl]
+        else:
+            sl = slice(0, tokens.shape[0])
+            tokens_feed = tokens
         # 1) PS plane: this batch's embedding rows — from the prefetch if
         # step(t-1) announced them, else pulled synchronously now
         ts = None
@@ -175,14 +216,38 @@ class HybridLMTrainer:
             else:  # caller deviated from the announced batch: drain + repull
                 self.worker.pull_result(pts, timeout=pull_timeout)
         if ts is None:
-            ts = self.worker.pull(self.table, tokens)
-        with self.tracer.span("hybrid.pull_wait"):
-            emb_in = self.worker.pull_result_device(ts, timeout=pull_timeout)
-        emb_d = jax.device_put(jnp.asarray(emb_in, jnp.float32), self._batch3)
-        tok_d = jax.device_put(jnp.asarray(tokens, jnp.int32), self._batch2)
+            ts = self.worker.pull(self.table, tokens_feed)
+        if multiproc:
+            from parameter_server_tpu.parallel import distributed
+
+            with self.tracer.span("hybrid.pull_wait"):
+                emb_local = self.worker.pull_result(ts, timeout=pull_timeout)
+            emb_d = distributed.host_local_batch(
+                self._batch3,
+                np.asarray(emb_local, np.float32),
+                (tokens.shape[0], tokens.shape[1], self.cfg.d_model),
+            )
+            tok_d = distributed.host_local_batch(
+                self._batch2,
+                np.ascontiguousarray(tokens_feed.astype(np.int32)),
+                tokens.shape,
+            )
+        else:
+            with self.tracer.span("hybrid.pull_wait"):
+                emb_in = self.worker.pull_result_device(
+                    ts, timeout=pull_timeout
+                )
+            emb_d = jax.device_put(
+                jnp.asarray(emb_in, jnp.float32), self._batch3
+            )
+            tok_d = jax.device_put(jnp.asarray(tokens, jnp.int32), self._batch2)
         # 2) dense plane: synchronous GSPMD body step (XLA allreduce).
-        # Dispatch is async — the arrays below are futures, so the prefetch
-        # and push issue while the body still runs on device.
+        # Single-process: dispatch is async — the arrays below are futures,
+        # so the prefetch and push issue while the body still runs on
+        # device.  Multi-process: _local_batch_rows below must block on the
+        # device step to read g_emb shards, so push/prefetch issue AFTER
+        # device compute there (the overlap window is the Van RTT against
+        # the NEXT step's host work, not against this body step).
         with self.tracer.span("hybrid.body_dispatch"):
             self.params, self.opt_state, loss, g_emb = self._step(
                 self.params, self.opt_state, emb_d, tok_d
@@ -193,16 +258,36 @@ class HybridLMTrainer:
         # submits, and per-link FIFO then guarantees the prefetched rows
         # include this step's update (pull-before-push would silently hand
         # back one-update-stale rows even at max_delay=0).
-        ts = self.worker.push_device(
-            self.table,
-            tokens.reshape(-1),
-            g_emb.reshape(-1, self.cfg.d_model),
-        )
+        if multiproc:
+            g_local = self._local_batch_rows(g_emb, sl)
+            ts = self.worker.push(
+                self.table,
+                tokens_feed.reshape(-1),
+                g_local.reshape(-1, self.cfg.d_model),
+            )
+        else:
+            ts = self.worker.push_device(
+                self.table,
+                tokens.reshape(-1),
+                g_emb.reshape(-1, self.cfg.d_model),
+            )
         # 4) prefetch the NEXT batch's rows while the body computes
         if next_tokens is not None:
             next_tokens = np.asarray(next_tokens)
+            if multiproc:
+                from parameter_server_tpu.parallel import distributed
+
+                # slice by the NEXT batch's size (it may differ from this
+                # step's), not this step's sl
+                nsl = distributed.local_batch_slice(
+                    jax.process_index(),
+                    jax.process_count(),
+                    next_tokens.shape[0],
+                )
+            else:
+                nsl = slice(0, next_tokens.shape[0])
             self._prefetch = (
-                self.worker.pull(self.table, next_tokens),
+                self.worker.pull(self.table, next_tokens[nsl]),
                 next_tokens,
             )
         self._inflight.append(ts)

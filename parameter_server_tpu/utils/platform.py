@@ -22,11 +22,19 @@ def force_cpu(n_devices: int = 0) -> None:
     """
     os.environ["JAX_PLATFORMS"] = "cpu"
     if n_devices:
+        import re
+
         flags = os.environ.get("XLA_FLAGS", "")
-        if "xla_force_host_platform_device_count" not in flags:
-            os.environ["XLA_FLAGS"] = (
-                flags + f" --xla_force_host_platform_device_count={n_devices}"
-            ).strip()
+        want = f"--xla_force_host_platform_device_count={n_devices}"
+        if "xla_force_host_platform_device_count" in flags:
+            # an inherited count (e.g. the test env's 8) must not override
+            # the caller's explicit topology — replace it
+            flags = re.sub(
+                r"--xla_force_host_platform_device_count=\d+", want, flags
+            )
+        else:
+            flags = (flags + " " + want).strip()
+        os.environ["XLA_FLAGS"] = flags
     try:
         import jax
 
