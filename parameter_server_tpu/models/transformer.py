@@ -42,6 +42,19 @@ class TransformerConfig:
     tie_embeddings: bool = False
     dtype: Any = jnp.float32
     rope_theta: float = 500_000.0
+    #: rematerialize each block on backward (jax.checkpoint): the bwd pass
+    #: then saves only the O(B*S*d) block inputs instead of every attention
+    #: score / d_ff intermediate — the HBM-for-FLOPs trade that makes the
+    #: 8B config fit a v5e-16 (SURVEY §7 step 7).
+    remat: bool = False
+    #: run the block stack as ONE lax.scan over stacked per-layer params
+    #: instead of a Python-unrolled loop.  Param tree changes shape: all
+    #: blocks live under ``blocks/block/...`` with a leading layer axis.
+    #: This is the at-scale layout: compile time is O(1) in depth, and
+    #: XLA's buffer liveness (and therefore remat's memory win) is explicit
+    #: — measured on the 8B feasibility path, unrolled remat saves ~nothing
+    #: while scan+remat cuts temp memory several-fold.
+    scan_blocks: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -179,6 +192,16 @@ class Block(nn.Module):
         return x + MLPBlock(cfg, name="mlp")(h)
 
 
+class _ScanBlock(nn.Module):
+    """Scan-body adapter: Block with the (carry, ys) return nn.scan wants."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions, attn_mask=None):
+        return Block(self.cfg, name="block")(x, positions, attn_mask), ()
+
+
 def _apply_body(mod: nn.Module, cfg: TransformerConfig, x, attn_mask):
     """Shared block stack: pos-emb + layers + final norm (no head).
 
@@ -197,8 +220,20 @@ def _apply_body(mod: nn.Module, cfg: TransformerConfig, x, attn_mask):
             (cfg.max_seq, cfg.d_model),
         )
         x = x + pos_emb[None, :S].astype(cfg.dtype)
-    for i in range(cfg.n_layers):
-        x = Block(cfg, name=f"layer_{i}")(x, positions, attn_mask)
+    if cfg.scan_blocks:
+        body_cls = nn.remat(_ScanBlock) if cfg.remat else _ScanBlock
+        scanned = nn.scan(
+            body_cls,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+            length=cfg.n_layers,
+            in_axes=(nn.broadcast, nn.broadcast),
+        )
+        x, _ = scanned(cfg, name="blocks")(x, positions, attn_mask)
+    else:
+        block_cls = nn.remat(Block) if cfg.remat else Block
+        for i in range(cfg.n_layers):
+            x = block_cls(cfg, name=f"layer_{i}")(x, positions, attn_mask)
     return Norm(cfg.norm, cfg.dtype, name="final_norm")(x)
 
 
@@ -226,6 +261,22 @@ class Transformer(nn.Module):
                 dtype=cfg.dtype,
             )(x)
         return logits.astype(jnp.float32)
+
+
+class TransformerTrunk(nn.Module):
+    """Block stack + final norm WITHOUT the lm_head: hidden states out.
+
+    Param names match :class:`TransformerBody` minus ``lm_head`` (both call
+    :func:`_apply_body` in their own scope), so a body param tree minus its
+    ``lm_head`` entry applies directly — the seam the memory-bounded chunked
+    loss needs (head matmul fused into the loss, logits never materialized).
+    """
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, attn_mask=None):
+        return _apply_body(self, self.cfg, x, attn_mask)
 
 
 class TransformerBody(nn.Module):
@@ -261,6 +312,60 @@ def causal_lm_loss(logits: jax.Array, tokens: jax.Array) -> jax.Array:
     tgt = tokens[:, 1:]
     nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
     return jnp.mean(nll)
+
+
+def chunked_causal_lm_loss(
+    hidden: jax.Array,
+    head_kernel: jax.Array,
+    tokens: jax.Array,
+    chunk: int = 1024,
+) -> jax.Array:
+    """Next-token CE with the head matmul fused into the loss, by chunks.
+
+    ``causal_lm_loss`` needs the full f32 ``[B, S, vocab]`` logits live (and
+    AD saves more copies for backward) — at Llama-3-8B scale (vocab 128k)
+    that one tensor dominates the step's memory.  Here the lm_head matmul
+    runs per sequence-chunk inside a rematerialized scan body: only one
+    ``[B, chunk, vocab]`` slab exists at a time and backward recomputes it,
+    so peak memory is O(S/chunk smaller) for ~one extra head matmul of
+    FLOPs.  Numerically identical to
+    ``causal_lm_loss(hidden @ head_kernel, tokens)`` up to summation order.
+    """
+    B, S, _d = hidden.shape
+    n = S - 1
+    xs = hidden[:, :-1]
+    tg = tokens[:, 1:]
+    chunk = min(chunk, n)
+    pad = (-n) % chunk
+    if pad:
+        xs = jnp.pad(xs, ((0, 0), (0, pad), (0, 0)))
+        tg = jnp.pad(tg, ((0, 0), (0, pad)))
+    valid = (jnp.arange(n + pad) < n)[None, :]
+    n_chunks = (n + pad) // chunk
+    xs = xs.reshape(B, n_chunks, chunk, -1).transpose(1, 0, 2, 3)
+    tg = tg.reshape(B, n_chunks, chunk).transpose(1, 0, 2)
+    mk = (
+        jnp.broadcast_to(valid, (B, n + pad))
+        .reshape(B, n_chunks, chunk)
+        .transpose(1, 0, 2)
+    )
+
+    @jax.checkpoint
+    def chunk_nll(xc, tc, mc):
+        logits = jnp.einsum(
+            "bcd,dv->bcv", xc, head_kernel,
+            preferred_element_type=jnp.float32,
+        )
+        logp = jax.nn.log_softmax(logits)
+        nll = -jnp.take_along_axis(logp, tc[..., None], axis=-1)[..., 0]
+        return jnp.sum(nll * mc)
+
+    def body(acc, args):
+        xc, tc, mc = args
+        return acc + chunk_nll(xc, tc, mc), None
+
+    total, _ = jax.lax.scan(body, jnp.float32(0.0), (xs, tg, mk))
+    return total / (B * n)
 
 
 def mlm_loss(logits: jax.Array, targets: jax.Array, mask: jax.Array) -> jax.Array:
