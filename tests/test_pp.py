@@ -79,7 +79,7 @@ def test_pipeline_trains():
 def test_pipeline_stage_weights_are_sharded():
     cfg = tfm.tiny_config(causal=True, n_layers=4)
     mesh = _pp_mesh(4)
-    trainer = PipelinedLMTrainer(cfg, mesh, n_micro=2)
+    trainer = PipelinedLMTrainer(cfg, mesh, n_micro=4)
     leaf = jax.tree.leaves(trainer.stage_params)[0]
     assert leaf.shape[0] == 4  # stage axis
     # one stage per device, not replicated
@@ -96,9 +96,12 @@ def test_pipeline_rejects_bad_shapes():
     with pytest.raises(ValueError, match="rotary"):
         PipelinedLMTrainer(bert_like, _pp_mesh(2), n_micro=2)
     mesh = _pp_mesh(2)
-    trainer = PipelinedLMTrainer(cfg, mesh, n_micro=3)
+    # microbatch stack shards over pp: n_micro must split across stages
     with pytest.raises(ValueError, match="n_micro"):
-        trainer.step(np.zeros((8, 16), np.int32))  # 8 % 3 != 0
+        PipelinedLMTrainer(cfg, mesh, n_micro=3)
+    trainer = PipelinedLMTrainer(cfg, mesh, n_micro=4)
+    with pytest.raises(ValueError, match="n_micro"):
+        trainer.step(np.zeros((9, 16), np.int32))  # 9 % 2 != 0
 
 
 def test_pipeline_gradients_match_sequential():
@@ -139,9 +142,73 @@ def test_pipeline_opt_state_stays_pp_sharded():
     """Adam moments for the stage stack must be pp-sharded from init —
     replicating them would cost 2x the full stack per device."""
     cfg = tfm.tiny_config(causal=True, n_layers=4)
-    trainer = PipelinedLMTrainer(cfg, _pp_mesh(4), n_micro=2)
+    trainer = PipelinedLMTrainer(cfg, _pp_mesh(4), n_micro=4)
     mu = jax.tree.leaves(trainer.opt_state[0].mu["stages"])[0]
     assert mu.addressable_shards[0].data.shape[0] == 1  # 1 of 4 stages
+
+
+def test_pipeline_per_device_memory_is_bounded_by_m_over_s_model():
+    """VERDICT r3 #8: the injection/output buffers are pp-sharded (O(M/S)
+    per device, was O(M) replicated) and the tick body is rematerialized.
+    Assert XLA's compiled per-device temps against the analytic budget:
+    2 x (M/S) microbatch buffers + (M+S-1) remat-saved tick inputs + a
+    working-set allowance — a regression that re-replicates the stack or
+    drops remat blows through the 3x headroom."""
+    cfg = tfm.tiny_config(
+        causal=True, n_layers=4, d_model=256, max_seq=256, vocab_size=512
+    )
+    S, M, mb, seq = 4, 16, 4, 256
+    mesh = _pp_mesh(S)
+    trainer = PipelinedLMTrainer(cfg, mesh, n_micro=M)
+    micro = jnp.zeros((M, mb, seq), jnp.int32)
+    ma = (
+        trainer._loss.lower(trainer._params(), micro).compile()
+        .memory_analysis()
+    )
+    act = mb * seq * cfg.d_model * 4  # one microbatch activation, f32
+    logits_mb = mb * seq * cfg.vocab_size * 4
+    budget = (
+        2 * (M // S) * act  # x stack + out_buf shards
+        + (M + S - 1) * 2 * act  # remat-saved tick inputs (fwd+bwd pair)
+        + (M // S) * logits_mb * 2  # local head logits + softmax copy
+        + 16 * act  # per-tick working set allowance
+    )
+    assert ma.temp_size_in_bytes <= 3 * budget, (
+        ma.temp_size_in_bytes,
+        budget,
+    )
+
+
+def test_pipeline_bubble_amortizes_with_microbatches():
+    """GPipe bubble model: per-example step time ~ (M+S-1)/M at fixed
+    microbatch size.  S=4: M=4 -> 1.75, M=16 -> 1.19 — raising M must cut
+    per-example time measurably (the table VERDICT r3 #8 asked for prints
+    to the log; the assert keeps only the robust monotonic claim)."""
+    import time
+
+    cfg = tfm.tiny_config(causal=True, n_layers=4, d_model=128, max_seq=64)
+    S, mb, seq = 4, 2, 64
+    mesh = _pp_mesh(S)
+    rng = np.random.default_rng(11)
+    rows = []
+    for M in (4, 16):
+        trainer = PipelinedLMTrainer(cfg, mesh, n_micro=M, seed=2)
+        tokens = _tokens(cfg, rng, batch=M * mb, seq=seq)
+        micro = jnp.asarray(trainer._micro(tokens))
+        params = trainer._params()
+        trainer._loss(params, micro)  # compile
+        t0 = time.perf_counter()
+        reps = 3
+        for _ in range(reps):
+            jax.block_until_ready(trainer._loss(params, micro))
+        per_example = (time.perf_counter() - t0) / reps / (M * mb)
+        rows.append((M, per_example, (M + S - 1) / M))
+        print(
+            f"pp bubble: M={M} per-example={per_example * 1e3:.3f} ms "
+            f"(model {(M + S - 1) / M:.2f}x ideal)"
+        )
+    # M=16 has 1.19x bubble vs M=4's 1.75x: per-example time must drop
+    assert rows[1][1] < rows[0][1], rows
 
 
 def test_pipeline_composes_with_dp():
