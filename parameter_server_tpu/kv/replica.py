@@ -100,4 +100,37 @@ def promote(van: Van, standby: KVServer, primary_id: str) -> KVServer:
     van.bind(primary_id, post._on_recv)
     post.node_id = primary_id
     van.unbind(old_id)
+    # fault-injection vans blackhole traffic by node id (the dead process's
+    # socket); the promoted standby re-opens the identity
+    reconnect = getattr(van, "reconnect", None)
+    if reconnect is not None:
+        reconnect(primary_id)
     return standby
+
+
+class ReplicaSet:
+    """Wire hot-standby promotion into the Manager's failure detection.
+
+    The composition the reference paper describes (heartbeats -> dead
+    server -> chain replica takes over the key range [U §4.3]): register
+    this on the SCHEDULER's manager and a missed-heartbeat death of
+    ``S{i}`` promotes standby ``i`` automatically — workers' next
+    pull/push to ``S{i}`` lands on the replica with the full post-
+    checkpoint state, instead of the snapshot-restore rewind
+    (``learner/elastic.py``'s fallback for un-replicated shards).
+    """
+
+    def __init__(self, van: Van, standbys: list, *, manager=None) -> None:
+        self.van = van
+        self.standbys = list(standbys)
+        self.promoted: dict[int, KVServer] = {}
+        if manager is not None:
+            manager.on_node_dead.append(self.on_node_dead)
+
+    def on_node_dead(self, node_id: str) -> None:
+        if not (node_id.startswith("S") and node_id[1:].isdigit()):
+            return  # worker deaths are the WorkloadPool's problem
+        idx = int(node_id[1:])
+        if idx in self.promoted or idx >= len(self.standbys):
+            return
+        self.promoted[idx] = promote(self.van, self.standbys[idx], node_id)

@@ -86,7 +86,13 @@ class HybridLMTrainer:
         dashboard: Optional[metrics_lib.Dashboard] = None,
         push_timeout: float = 60.0,
         tracer=None,
+        loss_chunk: int = 0,
     ) -> None:
+        """``loss_chunk > 0`` fuses the lm_head into the rematerialized
+        chunked loss (``chunked_causal_lm_loss``): the f32 [B, S, vocab]
+        logits never materialize — one of the three knobs (with
+        ``cfg.scan_blocks`` and ``cfg.remat``) that fit the 8B body on a
+        v5e-16 (see ``parallel/feasibility.py``)."""
         if cfg.tie_embeddings:
             raise ValueError(
                 "hybrid requires untied embeddings: the lm_head is dense "
@@ -114,9 +120,27 @@ class HybridLMTrainer:
         self.step_count = 0
         body, tx = self.body, self.tx
 
-        def loss_fn(params, emb_in, targets):
-            logits = body.apply({"params": params}, emb_in)
-            return tfm.causal_lm_loss(logits, targets)
+        if loss_chunk > 0:
+            trunk = tfm.TransformerTrunk(cfg)
+
+            def loss_fn(params, emb_in, targets):
+                hidden = trunk.apply(
+                    {
+                        "params": {
+                            k: v for k, v in params.items() if k != "lm_head"
+                        }
+                    },
+                    emb_in,
+                )
+                return tfm.chunked_causal_lm_loss(
+                    hidden, params["lm_head"]["kernel"], targets, loss_chunk
+                )
+
+        else:
+
+            def loss_fn(params, emb_in, targets):
+                logits = body.apply({"params": params}, emb_in)
+                return tfm.causal_lm_loss(logits, targets)
 
         batch3 = self._batch3
 

@@ -18,7 +18,6 @@ import optax
 
 from parameter_server_tpu.models import transformer as tfm
 from parameter_server_tpu.parallel import mesh as mesh_lib
-from parameter_server_tpu.parallel.tp import place_params
 from parameter_server_tpu.utils import metrics as metrics_lib
 
 
@@ -49,20 +48,53 @@ class SpmdLMTrainer:
         learning_rate: float = 1e-3,
         seed: int = 0,
         dashboard: Optional[metrics_lib.Dashboard] = None,
+        fsdp: bool = False,
+        loss_chunk: int = 0,
     ) -> None:
+        """``fsdp=True`` shards params AND optimizer moments over the data
+        axis besides the TP rules (see ``parallel/tp.py``); ``loss_chunk``
+        > 0 computes the causal loss with the fused-head rematerialized
+        chunks — the at-scale memory knobs, composable with
+        ``cfg.scan_blocks``/``cfg.remat``."""
         self.cfg = cfg
         self.mesh = mesh
         self.model = tfm.Transformer(cfg)
         self.tx = optax.adamw(learning_rate)
+        if loss_chunk > 0 and (not cfg.causal or cfg.tie_embeddings):
+            raise ValueError(
+                "loss_chunk requires a causal model with untied embeddings "
+                "(the fused head reads params['lm_head'])"
+            )
         tokens0 = jnp.zeros((1, 8), jnp.int32)
         params = self.model.init(jax.random.PRNGKey(seed), tokens0)["params"]
-        self.params = place_params(params, mesh)
+        from parameter_server_tpu.parallel.tp import (
+            transformer_param_shardings,
+        )
+
+        shardings = transformer_param_shardings(params, mesh, fsdp=fsdp)
+        params = jax.tree.map(jax.device_put, params, shardings)
+        self.params = params
         # optimizer state inherits param shardings through eager zeros_like
         self.opt_state = self.tx.init(self.params)
         self._batch2 = mesh_lib.batch_sharding(mesh, 2)
         model, tx = self.model, self.tx
 
-        if cfg.causal:
+        if cfg.causal and loss_chunk > 0:
+            trunk = tfm.TransformerTrunk(cfg)
+
+            def loss_fn(params, inputs, targets, mask):
+                x = jnp.take(params["embedding"], inputs, axis=0)
+                trunk_params = {
+                    k: v
+                    for k, v in params.items()
+                    if k not in ("embedding", "lm_head")
+                }
+                hidden = trunk.apply({"params": trunk_params}, x)
+                return tfm.chunked_causal_lm_loss(
+                    hidden, params["lm_head"]["kernel"], targets, loss_chunk
+                )
+
+        elif cfg.causal:
 
             def loss_fn(params, inputs, targets, mask):
                 logits = model.apply({"params": params}, inputs)

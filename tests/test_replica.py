@@ -128,6 +128,66 @@ def test_sync_chain_acks_after_replica_applied():
         van.close()
 
 
+def test_manager_heartbeat_death_triggers_promotion():
+    """End-to-end failure loop: the scheduler's heartbeat sweep detects the
+    dead primary and the ReplicaSet promotes its standby — workers keep
+    pulling from S0 without ever learning anything happened."""
+    import time
+
+    from parameter_server_tpu.core.manager import launch_local_cluster
+
+    van = LoopbackVan()
+    try:
+        sched, managers, posts = launch_local_cluster(
+            van, num_workers=1, num_servers=NUM_SERVERS,
+            heartbeat_timeout=0.6,
+        )
+        # KVServers/standbys bind their own endpoints next to the manager
+        # nodes (manager ids are the cluster identities; table traffic uses
+        # the kv customer on separate S*/R* postoffices in this in-process
+        # sim, so reuse the manager's S* postoffices for the primaries)
+        table_cfgs = _table_cfgs()
+        standbys = [
+            KVServer(
+                Postoffice(replica_lib.replica_id(s), van),
+                table_cfgs, s, NUM_SERVERS,
+            )
+            for s in range(NUM_SERVERS)
+        ]
+        primaries = [
+            KVServer(
+                posts[f"S{s}"], table_cfgs, s, NUM_SERVERS,
+                replica=replica_lib.replica_id(s), replica_sync=True,
+            )
+            for s in range(NUM_SERVERS)
+        ]
+        assert primaries
+        rset = replica_lib.ReplicaSet(van, standbys, manager=sched)
+        # the cluster already owns the W0 endpoint; attach the kv customer
+        worker = KVWorker(posts["W0"], table_cfgs, NUM_SERVERS)
+        batches = _batches()
+        losses_pre = _train(worker, batches[:4])
+        assert np.all(np.isfinite(losses_pre))
+
+        # keep every OTHER node's heartbeat fresh while S0 goes silent
+        van.disconnect("S0")  # the primary process dies
+        deadline = time.time() + 5.0
+        while time.time() < deadline and 0 not in rset.promoted:
+            for nid, mgr in managers.items():
+                if nid not in ("H", "S0"):
+                    mgr.send_heartbeat()
+            sched.check_heartbeats()
+            time.sleep(0.1)
+        assert 0 in rset.promoted, "heartbeat sweep never promoted standby 0"
+        assert not sched.is_alive("S0")
+        # pulls/pushes to S0 now land on the promoted standby: training
+        # continues with the full pre-death state (no checkpoint rewind)
+        losses_post = _train(worker, batches[4:8])
+        assert np.all(np.isfinite(losses_post))
+    finally:
+        van.close()
+
+
 def test_promotion_preserves_optimizer_state():
     """AdaGrad accumulators ride the chain too: post-promotion updates use
     the primary's accumulated state, not a fresh one (the silent-corruption
