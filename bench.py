@@ -842,7 +842,8 @@ def record_llama8b(record: dict, lines: list[str]) -> None:
             continue
         rows_md += (
             f"| ({r['mesh_cfg']}) | {r['batch']}x{r['seq']} | "
-            f"remat={r['remat']} chunk={r['loss_chunk']} fsdp={r['fsdp']} | "
+            f"scan={r.get('scan_blocks')} remat={r['remat']} "
+            f"chunk={r['loss_chunk']} fsdp={r['fsdp']} | "
             f"{r['argument_bytes'] / 1e9:.2f} | {r['temp_bytes'] / 1e9:.2f} | "
             f"**{r['peak_bytes'] / 1e9:.2f} GB** "
             f"{'FITS' if r['fits_v5e'] else 'OVER'} |\n"
