@@ -72,7 +72,9 @@ class SpmdDenseTrainer:
         self.tx = tx
         self.mesh = mesh
         self.loss_fn = loss_fn
-        self.dashboard = dashboard or metrics_lib.Dashboard(print_every=0)
+        self.dashboard = metrics_lib.trainer_dashboard(
+            dashboard, mesh.devices.size
+        )
         self.step_count = 0
         images, labels = example_batch
         variables = model.init(
@@ -122,10 +124,6 @@ class SpmdDenseTrainer:
             jax.ShapeDtypeStruct(lbl.shape, jnp.int32),
         )
         self.dashboard.flops_per_example = step_flops / max(img.shape[0], 1)
-        if self.dashboard.peak_flops <= 0.0:
-            self.dashboard.peak_flops = metrics_lib.mesh_peak_flops(
-                mesh.devices.size
-            )
 
     def step(self, images: np.ndarray, labels: np.ndarray) -> float:
         images = jax.device_put(jnp.asarray(images), self._batch_img)

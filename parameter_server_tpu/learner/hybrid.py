@@ -104,7 +104,9 @@ class HybridLMTrainer:
         self.table = table
         self.max_delay = max_delay
         self.push_timeout = push_timeout
-        self.dashboard = dashboard or metrics_lib.Dashboard(print_every=0)
+        self.dashboard = metrics_lib.trainer_dashboard(
+            dashboard, mesh.devices.size
+        )
         self.body = tfm.TransformerBody(cfg)
         self.tx = optax.adamw(learning_rate)
         x0 = jnp.zeros((1, 8, cfg.d_model), jnp.float32)
@@ -167,13 +169,6 @@ class HybridLMTrainer:
         self.n_body_params = sum(
             int(np.prod(p.shape)) for p in jax.tree.leaves(self.params)
         )
-        # the numerator counts FLOPs executed across the WHOLE mesh, so the
-        # denominator must be the mesh's aggregate peak — one chip's peak
-        # would report an 8-chip run at up to 800% MFU
-        if self.dashboard.peak_flops <= 0.0:
-            self.dashboard.peak_flops = metrics_lib.mesh_peak_flops(
-                self.mesh.devices.size
-            )
 
     def _local_batch_rows(self, arr: jax.Array, sl: slice) -> np.ndarray:
         """This process's rows ``[sl]`` of a batch-sharded global array.

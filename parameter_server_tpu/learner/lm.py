@@ -118,7 +118,9 @@ class SpmdLMTrainer:
         # participating params.  The input-embedding gather is not matmul
         # work UNLESS the table is tied (then it IS the lm_head projection);
         # positional embeddings are always a gather.
-        self.dashboard = dashboard or metrics_lib.Dashboard(print_every=0)
+        self.dashboard = metrics_lib.trainer_dashboard(
+            dashboard, mesh.devices.size
+        )
         drop = {"pos_embedding"} | (
             set() if cfg.tie_embeddings else {"embedding"}
         )
@@ -128,10 +130,6 @@ class SpmdLMTrainer:
             if k not in drop
             for leaf in jax.tree.leaves(sub)
         )
-        if self.dashboard.peak_flops <= 0.0:
-            self.dashboard.peak_flops = metrics_lib.mesh_peak_flops(
-                mesh.devices.size
-            )
         self.step_count = 0
 
     def _record(self, loss: float, batch: int, seq: int) -> None:

@@ -95,11 +95,9 @@ class SpmdDLRMTrainer:
         self.mesh = mesh
         self.n_sparse = n_sparse
         self.min_bucket = min_bucket
-        self.dashboard = dashboard or metrics_lib.Dashboard(print_every=0)
-        if self.dashboard.peak_flops <= 0.0:
-            self.dashboard.peak_flops = metrics_lib.mesh_peak_flops(
-                mesh.devices.size
-            )
+        self.dashboard = metrics_lib.trainer_dashboard(
+            dashboard, mesh.devices.size
+        )
         self.step_count = 0
         self._flops_shape = None  # (n_slots, batch) the cost analysis is for
         self.optimizer: ServerOptimizer = make_optimizer(table_cfg.optimizer)

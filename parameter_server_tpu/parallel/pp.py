@@ -321,17 +321,15 @@ class PipelinedLMTrainer:
         # layers) + head; the embedding gather is not matmul work.  GPipe's
         # fill/drain bubble is NOT credited: MFU counts model FLOPs, so the
         # bubble shows up as lower MFU, which is the honest accounting.
-        self.dashboard = dashboard or metrics_lib.Dashboard(print_every=0)
+        self.dashboard = metrics_lib.trainer_dashboard(
+            dashboard, mesh.devices.size
+        )
         self.n_matmul_params = sum(
             int(np.prod(leaf.shape))
             for leaf in jax.tree.leaves(self.stage_params)
         ) + int(np.prod(self.head.shape)) + sum(
             int(np.prod(leaf.shape)) for leaf in jax.tree.leaves(self.norm)
         )
-        if self.dashboard.peak_flops <= 0.0:
-            self.dashboard.peak_flops = metrics_lib.mesh_peak_flops(
-                mesh.devices.size
-            )
         self.step_count = 0
 
     def _params(self):

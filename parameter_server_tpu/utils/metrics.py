@@ -85,6 +85,19 @@ def mesh_peak_flops(n_devices: int) -> float:
     return _auto_peak_flops() * n_devices
 
 
+def trainer_dashboard(dashboard, n_devices: int) -> "Dashboard":
+    """The trainer-ctor idiom in one place: default Dashboard + mesh peak.
+
+    Every trainer calls this instead of repeating the
+    default-then-set-peak_flops dance (a caller-provided non-zero
+    ``peak_flops`` wins).
+    """
+    d = dashboard or Dashboard(print_every=0)
+    if d.peak_flops <= 0.0:
+        d.peak_flops = mesh_peak_flops(n_devices)
+    return d
+
+
 @dataclasses.dataclass
 class Dashboard:
     """Per-iteration progress table + JSONL sink.
