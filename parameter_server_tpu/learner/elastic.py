@@ -13,9 +13,14 @@ mirroring the reference's composition (heartbeats -> Manager REMOVE_NODE ->
   dead worker from the SSP bound so the window never wedges;
 - a dead **server** means lost shard state: recovery restores the shard from
   the latest committed checkpoint (``checkpoint.restore_shard``), which the
-  trainer writes every ``ckpt_every`` completed workloads.  The reference
-  paper's chain replication was at best partial in the open tree; snapshot
-  restore is the survey's chosen equivalent.
+  trainer writes every ``ckpt_every`` completed workloads — losing updates
+  since the snapshot.  For ZERO-loss recovery, chain-replicate the shard
+  instead: :mod:`parameter_server_tpu.kv.replica` forwards applied pushes
+  to a hot standby and a :class:`~parameter_server_tpu.kv.replica.ReplicaSet`
+  registered on the scheduler's manager promotes it on the same
+  ``on_node_dead`` signal this trainer uses (the reference paper's §4.3
+  replication, absent from the open tree).  Snapshot restore remains the
+  fallback for un-replicated shards.
 
 The trainer is Van-agnostic: fault injection in tests uses
 ``LoopbackVan.disconnect`` (a dead socket) + a forced heartbeat sweep, and the
