@@ -121,14 +121,11 @@ class SpmdLMTrainer:
         self.dashboard = metrics_lib.trainer_dashboard(
             dashboard, mesh.devices.size
         )
-        drop = {"pos_embedding"} | (
-            set() if cfg.tie_embeddings else {"embedding"}
+        drop = frozenset({"pos_embedding"}) | (
+            frozenset() if cfg.tie_embeddings else frozenset({"embedding"})
         )
-        self.n_matmul_params = sum(
-            int(np.prod(leaf.shape))
-            for k, sub in self.params.items()
-            if k not in drop
-            for leaf in jax.tree.leaves(sub)
+        self.n_matmul_params = metrics_lib.lm_matmul_params(
+            self.params, drop
         )
         self.step_count = 0
 

@@ -55,6 +55,14 @@ class TransformerConfig:
     #: — measured on the 8B feasibility path, unrolled remat saves ~nothing
     #: while scan+remat cuts temp memory several-fold.
     scan_blocks: bool = False
+    #: attention implementation: "dense" (full scores matrix) or "ring"
+    #: (sequence-parallel exact attention via ppermute over the ``sp_axis``
+    #: mesh axis — ONLY valid inside a shard_map that carries that axis;
+    #: ``parallel/sp_lm.py`` is the trainer that sets this up).  The param
+    #: tree is identical either way, so dense-initialized checkpoints load
+    #: into ring models and vice versa.
+    attn_impl: str = "dense"
+    sp_axis: str = "sp"
 
     @property
     def head_dim(self) -> int:
@@ -144,18 +152,31 @@ class Attention(nn.Module):
             rep = H // KV
             k = jnp.repeat(k, rep, axis=2)
             v = jnp.repeat(v, rep, axis=2)
-        scores = jnp.einsum(
-            "bshd,bthd->bhst", q, k, preferred_element_type=jnp.float32
-        ) / np.sqrt(D)
-        if cfg.causal:
-            causal = jnp.tril(jnp.ones((S, S), bool))
-            scores = jnp.where(causal[None, None], scores, -1e30)
-        if attn_mask is not None:  # [B, S] True = attend
-            scores = jnp.where(attn_mask[:, None, None, :], scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
-        out = jnp.einsum(
-            "bhst,bthd->bshd", probs, v, preferred_element_type=jnp.float32
-        ).astype(cfg.dtype)
+        if cfg.attn_impl == "ring":
+            if attn_mask is not None:
+                raise ValueError(
+                    "ring attention does not support attn_mask (padding "
+                    "masks are a dense-impl feature)"
+                )
+            from parameter_server_tpu.ops.ring_attention import ring_attention
+
+            out = ring_attention(
+                q, k, v, axis_name=cfg.sp_axis, causal=cfg.causal
+            ).astype(cfg.dtype)
+        else:
+            scores = jnp.einsum(
+                "bshd,bthd->bhst", q, k, preferred_element_type=jnp.float32
+            ) / np.sqrt(D)
+            if cfg.causal:
+                causal = jnp.tril(jnp.ones((S, S), bool))
+                scores = jnp.where(causal[None, None], scores, -1e30)
+            if attn_mask is not None:  # [B, S] True = attend
+                scores = jnp.where(attn_mask[:, None, None, :], scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+            out = jnp.einsum(
+                "bhst,bthd->bshd", probs, v,
+                preferred_element_type=jnp.float32,
+            ).astype(cfg.dtype)
         return nn.DenseGeneral(
             cfg.d_model, axis=(-2, -1), use_bias=cfg.norm == "ln", name="o",
             dtype=cfg.dtype,
@@ -202,24 +223,37 @@ class _ScanBlock(nn.Module):
         return Block(self.cfg, name="block")(x, positions, attn_mask), ()
 
 
-def _apply_body(mod: nn.Module, cfg: TransformerConfig, x, attn_mask):
+def _apply_body(mod: nn.Module, cfg: TransformerConfig, x, attn_mask,
+                positions=None):
     """Shared block stack: pos-emb + layers + final norm (no head).
 
     Called from inside a module's ``@nn.compact`` ``__call__``; submodules
     and params attach to the CALLER's scope with identical names, so
     :class:`Transformer` and :class:`TransformerBody` stay one
     implementation with interchangeable param trees.
+
+    ``positions``: GLOBAL token positions ``[B, S]`` — pass them when ``x``
+    is a sequence SHARD (SP: rotary phases and learned pos-emb rows must
+    use global offsets, not the local 0..S_local range).
     """
     B, S, _ = x.shape
     x = x.astype(cfg.dtype)
-    positions = jnp.broadcast_to(jnp.arange(S)[None], (B, S))
+    if positions is None:
+        if cfg.positional == "learned" and S > cfg.max_seq:
+            # the old slice failed loudly here; the gather below would
+            # silently clamp out-of-range rows instead — keep it loud
+            raise ValueError(
+                f"sequence {S} exceeds learned-positional max_seq "
+                f"{cfg.max_seq}"
+            )
+        positions = jnp.broadcast_to(jnp.arange(S)[None], (B, S))
     if cfg.positional == "learned":
         pos_emb = mod.param(
             "pos_embedding",
             nn.initializers.normal(0.02),
             (cfg.max_seq, cfg.d_model),
         )
-        x = x + pos_emb[None, :S].astype(cfg.dtype)
+        x = x + jnp.take(pos_emb, positions, axis=0).astype(cfg.dtype)
     if cfg.scan_blocks:
         body_cls = nn.remat(_ScanBlock) if cfg.remat else _ScanBlock
         scanned = nn.scan(
@@ -275,8 +309,8 @@ class TransformerTrunk(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, attn_mask=None):
-        return _apply_body(self, self.cfg, x, attn_mask)
+    def __call__(self, x, attn_mask=None, positions=None):
+        return _apply_body(self, self.cfg, x, attn_mask, positions)
 
 
 class TransformerBody(nn.Module):

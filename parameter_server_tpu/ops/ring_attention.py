@@ -55,28 +55,17 @@ def _block_attn(q, k, v, q_off, k_off, scale, causal):
     return m_safe, l, o
 
 
-def ring_attention(
-    q: jax.Array,
-    k: jax.Array,
-    v: jax.Array,
-    *,
-    axis_name: str,
-    causal: bool = False,
-) -> jax.Array:
-    """Exact attention over a sequence sharded on ``axis_name``.
-
-    Call inside shard_map.  q/k/v: [B, S_local, H, D] (same H on every
-    device — combine with Ulysses/TP for head sharding).  Returns
-    [B, S_local, H, D] in q.dtype.
-    """
+def _ring_forward(q32, k32, v32, axis_name: str, causal: bool):
+    """Online-softmax ring pass.  Returns (out_f32, logsumexp [B,H,Sq])."""
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
-    s_local = q.shape[1]
-    scale = 1.0 / np.sqrt(q.shape[-1])
-    q32, k32, v32 = (x.astype(jnp.float32) for x in (q, k, v))
+    s_local = q32.shape[1]
+    scale = 1.0 / np.sqrt(q32.shape[-1])
     q_off = idx * s_local
 
-    m0 = jnp.full(q.shape[:1] + (q.shape[2], s_local), -jnp.inf, jnp.float32)
+    m0 = jnp.full(
+        q32.shape[:1] + (q32.shape[2], s_local), -jnp.inf, jnp.float32
+    )
     l0 = jnp.zeros_like(m0)
     # constants must be marked device-varying to carry through the ring loop
     m0 = jax.lax.pcast(m0, (axis_name,), to="varying")
@@ -84,7 +73,7 @@ def ring_attention(
     o0 = jnp.zeros_like(q32)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    def body(r, carry):
+    def body(carry, r):
         m, l, o, kr, vr = carry  # noqa: E741
         src = (idx - r) % n  # ring step r holds the block from device src
         k_off = src * s_local
@@ -100,11 +89,124 @@ def ring_attention(
         )
         kr = jax.lax.ppermute(kr, axis_name, perm)
         vr = jax.lax.ppermute(vr, axis_name, perm)
-        return new_m, new_l, new_o, kr, vr
+        return (new_m, new_l, new_o, kr, vr), None
 
-    m, l, o, _, _ = jax.lax.fori_loop(0, n, body, (m0, l0, o0, k32, v32))  # noqa: E741
-    denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
-    return (o / denom).astype(q.dtype)
+    (m, l, o, _, _), _ = jax.lax.scan(  # noqa: E741
+        body, (m0, l0, o0, k32, v32), jnp.arange(n)
+    )
+    l_safe = jnp.maximum(l, 1e-30)
+    out = o / l_safe.transpose(0, 2, 1)[..., None]
+    lse = m + jnp.log(l_safe)  # [B, H, Sq]
+    return out, lse
+
+
+@functools.lru_cache(maxsize=None)
+def _ring_with_flash_bwd(axis_name: str, causal: bool):
+    """custom_vjp ring attention with the blockwise (flash-style) backward.
+
+    Plain reverse-AD through the ring either saves every step's
+    [B, H, S/n, S/n] score blocks (O(S^2/n) per device) or — under
+    jax.checkpoint — every step's visiting K/V blocks (O(S) per device,
+    not shrinking with ring size).  The flash recurrence needs neither:
+    forward saves only the LOCAL q/k/v/out plus the per-query logsumexp,
+    and backward re-rotates K/V around the ring with the dK/dV
+    accumulators riding along — after n steps each accumulator is home at
+    its owner.  Per-device residuals are O(S/n); per-step temps are the
+    (S/n)^2 block working set, recomputed.
+    """
+
+    @jax.custom_vjp
+    def fn(q32, k32, v32):
+        return _ring_forward(q32, k32, v32, axis_name, causal)[0]
+
+    def fwd(q32, k32, v32):
+        out, lse = _ring_forward(q32, k32, v32, axis_name, causal)
+        return out, (q32, k32, v32, out, lse)
+
+    def bwd(res, g):
+        q32, k32, v32, out, lse = res
+        do = g.astype(jnp.float32)
+        n = jax.lax.axis_size(axis_name)
+        idx = jax.lax.axis_index(axis_name)
+        s_local = q32.shape[1]
+        scale = 1.0 / np.sqrt(q32.shape[-1])
+        q_off = idx * s_local
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        # D_i = rowsum(dO * O) per query [B, H, Sq]
+        d_term = jnp.einsum("bqhd,bqhd->bhq", do, out)
+
+        def body(carry, r):
+            dq, dk_r, dv_r, kr, vr = carry
+            src = (idx - r) % n
+            k_off = src * s_local
+            s = jnp.einsum(
+                "bqhd,bkhd->bhqk", q32, kr,
+                preferred_element_type=jnp.float32,
+            ) * scale
+            if causal:
+                sq, sk = s_local, s_local
+                q_ids = q_off + jax.lax.broadcasted_iota(
+                    jnp.int32, (sq, sk), 0
+                )
+                k_ids = k_off + jax.lax.broadcasted_iota(
+                    jnp.int32, (sq, sk), 1
+                )
+                s = jnp.where((k_ids <= q_ids)[None, None], s, -jnp.inf)
+            p = jnp.exp(s - lse[..., None])  # exact probs (masked -> 0)
+            dp = jnp.einsum(
+                "bqhd,bkhd->bhqk", do, vr,
+                preferred_element_type=jnp.float32,
+            )
+            ds = p * (dp - d_term[..., None])
+            dq = dq + jnp.einsum(
+                "bhqk,bkhd->bqhd", ds, kr,
+                preferred_element_type=jnp.float32,
+            ) * scale
+            # gradient for the VISITING block, accumulated in ring order:
+            # after n rotations it is back at the block's owner
+            dk_r = dk_r + jnp.einsum(
+                "bhqk,bqhd->bkhd", ds, q32,
+                preferred_element_type=jnp.float32,
+            ) * scale
+            dv_r = dv_r + jnp.einsum(
+                "bhqk,bqhd->bkhd", p, do,
+                preferred_element_type=jnp.float32,
+            )
+            kr = jax.lax.ppermute(kr, axis_name, perm)
+            vr = jax.lax.ppermute(vr, axis_name, perm)
+            dk_r = jax.lax.ppermute(dk_r, axis_name, perm)
+            dv_r = jax.lax.ppermute(dv_r, axis_name, perm)
+            return (dq, dk_r, dv_r, kr, vr), None
+
+        zeros = jnp.zeros_like(k32)
+        dq0 = jnp.zeros_like(q32)
+        (dq, dk, dv, _, _), _ = jax.lax.scan(
+            body, (dq0, zeros, jnp.zeros_like(v32), k32, v32), jnp.arange(n)
+        )
+        return dq, dk, dv
+
+    fn.defvjp(fwd, bwd)
+    return fn
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    causal: bool = False,
+) -> jax.Array:
+    """Exact attention over a sequence sharded on ``axis_name``.
+
+    Call inside shard_map.  q/k/v: [B, S_local, H, D] (same H on every
+    device — combine with Ulysses/TP for head sharding).  Returns
+    [B, S_local, H, D] in q.dtype.  Differentiable with the flash-style
+    ring backward (O(S/n) residuals per device).
+    """
+    q32, k32, v32 = (x.astype(jnp.float32) for x in (q, k, v))
+    out = _ring_with_flash_bwd(axis_name, causal)(q32, k32, v32)
+    return out.astype(q.dtype)
 
 
 def make_ring_attention(

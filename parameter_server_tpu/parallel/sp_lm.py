@@ -1,0 +1,171 @@
+"""Sequence-parallel causal-LM trainer: ring attention INSIDE the model.
+
+Long-context training as a first-class trainer, not just a library op
+(SURVEY §5 long-context row): the sequence axis is sharded over an ``sp``
+mesh axis, every position-local sublayer (norms, MLP, rotary, embedding
+gather, head matmul, loss) runs on the local shard untouched, and attention
+is the exact ring algorithm (``ops/ring_attention.py``) — K/V blocks rotate
+over ICI ppermute while each device accumulates the online softmax for its
+Q shard.  Per-device activation memory is O(seq/n) blockwise (asserted at
+8k tokens in tests/test_seq_parallel.py); this module makes a transformer
+TRAIN in that regime end to end.
+
+The whole step is one jit program: shard_map over ``sp`` (inputs sharded on
+the sequence axis, params replicated — their gradients psum over ``sp`` by
+the shard_map transpose rule), reverse-AD through the ring, adamw update.
+The param tree is identical to the dense-attention model, so checkpoints
+move freely between the two.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from parameter_server_tpu.models import transformer as tfm
+from parameter_server_tpu.utils import metrics as metrics_lib
+
+SP_AXIS = "sp"
+
+
+class SpLMTrainer:
+    """Causal LM trained with the sequence sharded over ``sp``."""
+
+    def __init__(
+        self,
+        cfg: tfm.TransformerConfig,
+        mesh: Mesh,
+        *,
+        learning_rate: float = 1e-3,
+        seed: int = 0,
+        dashboard: Optional[metrics_lib.Dashboard] = None,
+    ) -> None:
+        import optax
+
+        if SP_AXIS not in mesh.axis_names:
+            raise ValueError(
+                f"mesh must carry a {SP_AXIS!r} axis, got {mesh.axis_names}"
+            )
+        if not cfg.causal:
+            raise ValueError("SpLMTrainer is a causal-LM trainer")
+        if cfg.tie_embeddings:
+            raise ValueError(
+                "SpLMTrainer needs untied embeddings (the head matmul runs "
+                "on sequence shards via params['lm_head'])"
+            )
+        self.mesh = mesh
+        self.n_shards = mesh.shape[SP_AXIS]
+        #: the ring-attention twin of the caller's config (same param tree)
+        self.cfg = dataclasses.replace(
+            cfg, attn_impl="ring", sp_axis=SP_AXIS
+        )
+        cfg_dense = dataclasses.replace(cfg, attn_impl="dense")
+        self.tx = optax.adamw(learning_rate)
+
+        # init OUTSIDE shard_map with the dense twin (identical params)
+        model_init = tfm.Transformer(cfg_dense)
+        tokens0 = jnp.zeros((1, 8), jnp.int32)
+        params = model_init.init(jax.random.PRNGKey(seed), tokens0)["params"]
+        repl = NamedSharding(mesh, P())
+        self.params = jax.device_put(params, repl)
+        self.opt_state = jax.device_put(self.tx.init(self.params), repl)
+
+        trunk = tfm.TransformerTrunk(self.cfg)
+        tx = self.tx
+
+        def local_loss(params, tok_l, tgt_l, msk_l):
+            # inside shard_map: tok_l [B, S/n] — this device's seq shard
+            idx = jax.lax.axis_index(SP_AXIS)
+            B, s_local = tok_l.shape
+            positions = jnp.broadcast_to(
+                idx * s_local + jnp.arange(s_local)[None], (B, s_local)
+            )
+            x = jnp.take(params["embedding"], tok_l, axis=0)
+            trunk_params = {
+                k: v
+                for k, v in params.items()
+                if k not in ("embedding", "lm_head")
+            }
+            hidden = trunk.apply(
+                {"params": trunk_params}, x, positions=positions
+            )
+            logits = jnp.einsum(
+                "bsd,dv->bsv", hidden, params["lm_head"]["kernel"],
+                preferred_element_type=jnp.float32,
+            )
+            logp = jax.nn.log_softmax(logits)
+            nll = -jnp.take_along_axis(logp, tgt_l[..., None], axis=-1)[..., 0]
+            loss_sum = jax.lax.psum(jnp.sum(nll * msk_l), SP_AXIS)
+            count = jax.lax.psum(jnp.sum(msk_l), SP_AXIS)
+            return loss_sum / jnp.maximum(count, 1.0)
+
+        seq_spec = P(None, SP_AXIS)
+
+        def loss_from(params, tokens, targets, mask):
+            shard = jax.shard_map(
+                local_loss,
+                mesh=mesh,
+                in_specs=(P(), seq_spec, seq_spec, seq_spec),
+                out_specs=P(),
+            )
+            return shard(params, tokens, targets, mask)
+
+        def step_fn(params, opt_state, tokens, targets, mask):
+            loss, grads = jax.value_and_grad(loss_from)(
+                params, tokens, targets, mask
+            )
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        self._step = jax.jit(step_fn, donate_argnums=(0, 1))
+        self._loss = jax.jit(loss_from)
+        self._seq_sharding = NamedSharding(mesh, seq_spec)
+
+        # MFU wiring: 6ND over matmul-participating params (gathers out)
+        self.dashboard = metrics_lib.trainer_dashboard(
+            dashboard, mesh.devices.size
+        )
+        self.n_matmul_params = metrics_lib.lm_matmul_params(
+            self.params, frozenset({"pos_embedding", "embedding"})
+        )
+        self.step_count = 0
+
+    def _place(self, tokens: np.ndarray):
+        """Host-side next-token shift + mask, sharded on the seq axis."""
+        tokens = np.asarray(tokens, np.int32)
+        B, S = tokens.shape
+        if S % self.n_shards:
+            raise ValueError(f"seq {S} % sp shards {self.n_shards} != 0")
+        targets = np.concatenate(
+            [tokens[:, 1:], np.zeros((B, 1), np.int32)], axis=1
+        )
+        mask = np.broadcast_to(
+            (np.arange(S) < S - 1).astype(np.float32), (B, S)
+        )
+        put = lambda a: jax.device_put(a, self._seq_sharding)  # noqa: E731
+        return put(tokens), put(targets), put(np.ascontiguousarray(mask))
+
+    def step(self, tokens: np.ndarray) -> float:
+        tok, tgt, msk = self._place(tokens)
+        self.params, self.opt_state, loss = self._step(
+            self.params, self.opt_state, tok, tgt, msk
+        )
+        loss_f = float(loss)
+        self.step_count += 1
+        self.dashboard.flops_per_example = (
+            6.0 * self.n_matmul_params * tokens.shape[1]
+        )
+        self.dashboard.record(
+            self.step_count, loss_f, examples=int(tokens.shape[0])
+        )
+        return loss_f
+
+    def loss(self, tokens: np.ndarray) -> float:
+        tok, tgt, msk = self._place(tokens)
+        return float(self._loss(self.params, tok, tgt, msk))

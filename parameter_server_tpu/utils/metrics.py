@@ -85,6 +85,23 @@ def mesh_peak_flops(n_devices: int) -> float:
     return _auto_peak_flops() * n_devices
 
 
+def lm_matmul_params(params, drop: frozenset) -> int:
+    """6ND numerator: total size of matmul-participating param leaves.
+
+    ``drop``: top-level keys that are gathers, not matmuls (the input
+    embedding table when untied, positional embeddings).  Shared by every
+    transformer trainer so the MFU accounting cannot drift between them.
+    """
+    import jax
+
+    return sum(
+        int(np.prod(leaf.shape))
+        for k, sub in params.items()
+        if k not in drop
+        for leaf in jax.tree.leaves(sub)
+    )
+
+
 def trainer_dashboard(dashboard, n_devices: int) -> "Dashboard":
     """The trainer-ctor idiom in one place: default Dashboard + mesh peak.
 

@@ -1,0 +1,111 @@
+"""Sequence-parallel LM trainer: ring attention inside the model, trained.
+
+The long-context claim at trainer level: the SP trainer must compute the
+SAME function as the dense single-mesh trainer (same params, same stream),
+train end to end, and keep the per-device O(seq/n) memory shape.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from parameter_server_tpu.learner.lm import SpmdLMTrainer
+from parameter_server_tpu.models import transformer as tfm
+from parameter_server_tpu.parallel import mesh as mesh_lib
+from parameter_server_tpu.parallel.sp_lm import SpLMTrainer
+
+
+def _sp_mesh(n=8):
+    return Mesh(np.asarray(jax.devices()[:n]), ("sp",))
+
+
+def _cfg(**kw):
+    defaults = dict(
+        causal=True, tie_embeddings=False, n_heads=4, n_kv_heads=4,
+        max_seq=256,
+    )
+    defaults.update(kw)
+    return tfm.tiny_config(**defaults)
+
+
+def _tokens(cfg, rng, batch=4, seq=64):
+    return rng.integers(0, cfg.vocab_size, size=(batch, seq)).astype(np.int32)
+
+
+def test_sp_trainer_matches_dense_trainer_trajectory():
+    """Same init seed, same stream: the 8-shard ring trajectory equals the
+    dense single-mesh trajectory (the param trees are identical and the
+    ring computes exact attention)."""
+    cfg = _cfg()
+    rng = np.random.default_rng(0)
+    batches = [_tokens(cfg, rng) for _ in range(4)]
+
+    dense = SpmdLMTrainer(
+        cfg, mesh_lib.make_mesh((1, 1), devices=jax.devices()[:1]),
+        learning_rate=1e-2, seed=3,
+    )
+    sp = SpLMTrainer(cfg, _sp_mesh(8), learning_rate=1e-2, seed=3)
+    for b in batches:
+        np.testing.assert_allclose(
+            sp.step(b), dense.step_causal(b), rtol=2e-4, atol=1e-5
+        )
+
+
+def test_sp_trainer_trains_long_sequences():
+    cfg = _cfg(max_seq=2048)
+    sp = SpLMTrainer(cfg, _sp_mesh(8), learning_rate=3e-3, seed=1)
+    rng = np.random.default_rng(2)
+    # structured stream a tiny model can learn
+    base = rng.integers(0, cfg.vocab_size, size=(2, 1))
+    offs = np.arange(1024)[None, :]
+    tokens = ((base + offs) % cfg.vocab_size).astype(np.int32)
+    losses = [sp.step(tokens) for _ in range(8)]
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-2:]) < np.mean(losses[:2]), losses
+
+
+def test_sp_trainer_memory_stays_blockwise():
+    """The compiled SP step must not materialize the O(S^2) score matrix:
+    per-device temps at seq 4096 stay far below the full matrix bytes."""
+    cfg = _cfg(max_seq=4096, n_layers=2)
+    sp = SpLMTrainer(cfg, _sp_mesh(8), seed=0)
+    B, S = 1, 4096
+    tok = jax.ShapeDtypeStruct((B, S), jnp.int32, sharding=sp._seq_sharding)
+    msk = jax.ShapeDtypeStruct(
+        (B, S), jnp.float32, sharding=sp._seq_sharding
+    )
+    ma = (
+        sp._step.lower(sp.params, sp.opt_state, tok, tok, msk)
+        .compile()
+        .memory_analysis()
+    )
+    scores_bytes = B * cfg.n_heads * S * S * 4  # the full matrix, per layer
+    assert ma.temp_size_in_bytes < scores_bytes, (
+        ma.temp_size_in_bytes,
+        scores_bytes,
+    )
+
+
+def test_sp_trainer_scan_blocks_composes():
+    """SP x scan-over-layers x remat: the 8B-recipe structure under ring
+    attention compiles and trains."""
+    cfg = _cfg(scan_blocks=True, remat=True, n_layers=2)
+    sp = SpLMTrainer(cfg, _sp_mesh(8), learning_rate=3e-3, seed=4)
+    rng = np.random.default_rng(5)
+    losses = [sp.step(_tokens(cfg, rng)) for _ in range(4)]
+    assert np.isfinite(losses).all()
+
+
+def test_sp_trainer_rejects_bad_configs():
+    with pytest.raises(ValueError, match="sp"):
+        SpLMTrainer(_cfg(), mesh_lib.make_mesh((2, 4)))
+    with pytest.raises(ValueError, match="causal"):
+        SpLMTrainer(
+            tfm.tiny_config(causal=False, tie_embeddings=False), _sp_mesh(2)
+        )
+    sp = SpLMTrainer(_cfg(), _sp_mesh(8))
+    with pytest.raises(ValueError, match="sp shards"):
+        sp.step(np.zeros((2, 60), np.int32))  # 60 % 8 != 0
