@@ -63,13 +63,12 @@ def _ring_forward(q32, k32, v32, axis_name: str, causal: bool):
     scale = 1.0 / np.sqrt(q32.shape[-1])
     q_off = idx * s_local
 
-    m0 = jnp.full(
-        q32.shape[:1] + (q32.shape[2], s_local), -jnp.inf, jnp.float32
-    )
+    # derive the carries from q32 so they inherit its device-varying spec:
+    # under a composed mesh (e.g. data x sp) the loop values vary over
+    # EVERY axis the inputs shard on, not just the ring axis — a pcast to
+    # ("sp",) alone would type-mismatch the scan carry there
+    m0 = jnp.transpose(q32[..., 0], (0, 2, 1)) * 0.0 - jnp.inf  # [B,H,Sq]
     l0 = jnp.zeros_like(m0)
-    # constants must be marked device-varying to carry through the ring loop
-    m0 = jax.lax.pcast(m0, (axis_name,), to="varying")
-    l0 = jax.lax.pcast(l0, (axis_name,), to="varying")
     o0 = jnp.zeros_like(q32)
     perm = [(i, (i + 1) % n) for i in range(n)]
 

@@ -60,6 +60,12 @@ class SpLMTrainer:
             )
         self.mesh = mesh
         self.n_shards = mesh.shape[SP_AXIS]
+        #: DP x SP composition: a "data" axis beside "sp" shards the batch
+        #: rows; the loss mean over both axes transposes to the usual DP
+        #: gradient psum on top of the SP one.
+        from parameter_server_tpu.parallel.mesh import DATA_AXIS
+
+        self._data_axis = DATA_AXIS if DATA_AXIS in mesh.axis_names else None
         #: the ring-attention twin of the caller's config (same param tree)
         self.cfg = dataclasses.replace(
             cfg, attn_impl="ring", sp_axis=SP_AXIS
@@ -100,11 +106,16 @@ class SpLMTrainer:
             )
             logp = jax.nn.log_softmax(logits)
             nll = -jnp.take_along_axis(logp, tgt_l[..., None], axis=-1)[..., 0]
-            loss_sum = jax.lax.psum(jnp.sum(nll * msk_l), SP_AXIS)
-            count = jax.lax.psum(jnp.sum(msk_l), SP_AXIS)
+            axes = (
+                (SP_AXIS,)
+                if self._data_axis is None
+                else (self._data_axis, SP_AXIS)
+            )
+            loss_sum = jax.lax.psum(jnp.sum(nll * msk_l), axes)
+            count = jax.lax.psum(jnp.sum(msk_l), axes)
             return loss_sum / jnp.maximum(count, 1.0)
 
-        seq_spec = P(None, SP_AXIS)
+        seq_spec = P(self._data_axis, SP_AXIS)
 
         def loss_from(params, tokens, targets, mask):
             shard = jax.shard_map(
