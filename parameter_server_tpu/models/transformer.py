@@ -152,17 +152,26 @@ class Attention(nn.Module):
             rep = H // KV
             k = jnp.repeat(k, rep, axis=2)
             v = jnp.repeat(v, rep, axis=2)
-        if cfg.attn_impl == "ring":
+        if cfg.attn_impl in ("ring", "ulysses"):
             if attn_mask is not None:
                 raise ValueError(
-                    "ring attention does not support attn_mask (padding "
-                    "masks are a dense-impl feature)"
+                    "sequence-parallel attention does not support attn_mask "
+                    "(padding masks are a dense-impl feature)"
                 )
-            from parameter_server_tpu.ops.ring_attention import ring_attention
+            if cfg.attn_impl == "ring":
+                from parameter_server_tpu.ops.ring_attention import (
+                    ring_attention,
+                )
 
-            out = ring_attention(
-                q, k, v, axis_name=cfg.sp_axis, causal=cfg.causal
-            ).astype(cfg.dtype)
+                out = ring_attention(
+                    q, k, v, axis_name=cfg.sp_axis, causal=cfg.causal
+                ).astype(cfg.dtype)
+            else:
+                from parameter_server_tpu.ops.ulysses import ulysses_attention
+
+                out = ulysses_attention(
+                    q, k, v, axis_name=cfg.sp_axis, causal=cfg.causal
+                ).astype(cfg.dtype)
         else:
             scores = jnp.einsum(
                 "bshd,bthd->bhst", q, k, preferred_element_type=jnp.float32

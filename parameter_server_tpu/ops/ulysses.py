@@ -27,28 +27,21 @@ def ulysses_attention(
 
     Returns [B, S_local, H, D].
     """
-    n = jax.lax.axis_size(axis_name)
-
+    # Tiled all_to_alls: split one axis into n source-ordered chunks,
+    # concatenate received chunks on another — seq_to_heads and
+    # heads_to_seq are exact mirrors, head order stays group-major, and
+    # (unlike the earlier reshape-and-transpose formulation) the transpose
+    # rule is clean, so reverse-AD through the attention works — required
+    # since SpLMTrainer(attn="ulysses") TRAINS through this op.
     def seq_to_heads(x):  # [B, S_loc, H, D] -> [B, S_glob, H/n, D]
-        b, s_loc, h, d = x.shape
-        x = x.reshape(b, s_loc, n, h // n, d)
-        # all_to_all: split axis 2 (head groups) across devices, concat axis 1
-        x = jax.lax.all_to_all(
-            x, axis_name, split_axis=2, concat_axis=1, tiled=False
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=True
         )
-        return x.reshape(b, n * s_loc, h // n, d)
 
     def heads_to_seq(x):  # [B, S_glob, H/n, D] -> [B, S_loc, H, D]
-        b, s_glob, hn, d = x.shape
-        x = x.reshape(b, n, s_glob // n, hn, d)
-        x = jax.lax.all_to_all(
-            x, axis_name, split_axis=1, concat_axis=3, tiled=False
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=1, concat_axis=2, tiled=True
         )
-        # received shape [B, S_loc, hn, n, D]: the materialized source-device
-        # axis (== head GROUP) lands after the within-group axis; global head
-        # order is group-major, so swap before flattening.
-        x = x.transpose(0, 1, 3, 2, 4)
-        return x.reshape(b, s_glob // n, n * hn, d)
 
     qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
     out = reference_attention(qg, kg, vg, causal=causal)

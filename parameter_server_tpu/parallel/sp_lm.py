@@ -44,9 +44,16 @@ class SpLMTrainer:
         learning_rate: float = 1e-3,
         seed: int = 0,
         dashboard: Optional[metrics_lib.Dashboard] = None,
+        attn: str = "ring",
     ) -> None:
+        """``attn``: "ring" (K/V rotate; O(S/n) memory everywhere, the
+        long-context default) or "ulysses" (all-to-all head redistribution;
+        two collectives per attention, full-sequence scores per head subset
+        — preferable when heads >> devices and S^2/n_heads fits)."""
         import optax
 
+        if attn not in ("ring", "ulysses"):
+            raise ValueError(f"attn must be ring|ulysses, got {attn!r}")
         if SP_AXIS not in mesh.axis_names:
             raise ValueError(
                 f"mesh must carry a {SP_AXIS!r} axis, got {mesh.axis_names}"
@@ -66,10 +73,8 @@ class SpLMTrainer:
         from parameter_server_tpu.parallel.mesh import DATA_AXIS
 
         self._data_axis = DATA_AXIS if DATA_AXIS in mesh.axis_names else None
-        #: the ring-attention twin of the caller's config (same param tree)
-        self.cfg = dataclasses.replace(
-            cfg, attn_impl="ring", sp_axis=SP_AXIS
-        )
+        #: the SP twin of the caller's config (same param tree)
+        self.cfg = dataclasses.replace(cfg, attn_impl=attn, sp_axis=SP_AXIS)
         cfg_dense = dataclasses.replace(cfg, attn_impl="dense")
         self.tx = optax.adamw(learning_rate)
 

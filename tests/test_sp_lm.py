@@ -132,3 +132,22 @@ def test_sp_composes_with_dp():
         np.testing.assert_allclose(
             dp_sp.step(b), dense.step_causal(b), rtol=2e-4, atol=1e-5
         )
+
+
+def test_sp_trainer_ulysses_matches_dense():
+    """attn="ulysses": all-to-all head redistribution gives the same
+    trajectory as the dense trainer (n_heads % shards == 0)."""
+    cfg = _cfg()  # 4 heads, 4 shards
+    rng = np.random.default_rng(8)
+    batches = [_tokens(cfg, rng) for _ in range(3)]
+    dense = SpmdLMTrainer(
+        cfg, mesh_lib.make_mesh((1, 1), devices=jax.devices()[:1]),
+        learning_rate=1e-2, seed=11,
+    )
+    uly = SpLMTrainer(
+        cfg, _sp_mesh(4), learning_rate=1e-2, seed=11, attn="ulysses"
+    )
+    for b in batches:
+        np.testing.assert_allclose(
+            uly.step(b), dense.step_causal(b), rtol=2e-4, atol=1e-5
+        )
