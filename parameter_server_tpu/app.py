@@ -314,6 +314,46 @@ def _build_llama_hybrid(cfg: AppConfig) -> Callable[[], dict]:
     return run
 
 
+@register_app("sp_lm")
+def _build_sp_lm(cfg: AppConfig) -> Callable[[], dict]:
+    """Long-context causal LM: the sequence axis sharded over EVERY device
+    (``parallel/sp_lm.py``), ring attention inside the transformer.  The
+    vocab is ``data.key_space`` (kept small by default); ``data.batch_size``
+    is the batch; the sequence length is ``data.nnz * 64`` rounded up to a
+    multiple of the device count (nnz reused as a length knob so the app
+    config stays one schema)."""
+
+    def run() -> dict:
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
+        from parameter_server_tpu.models import transformer as tfm
+        from parameter_server_tpu.parallel.sp_lm import SpLMTrainer
+
+        devices = jax.devices()
+        n_dev = len(devices)
+        model_cfg = tfm.tiny_config(
+            causal=True, tie_embeddings=False,
+            vocab_size=min(cfg.data.key_space, 1 << 16),
+            max_seq=1 << 16,
+        )
+        seq = max(cfg.data.nnz, 1) * 64
+        seq = ((seq + n_dev - 1) // n_dev) * n_dev
+        mesh = Mesh(np.asarray(devices), ("sp",))
+        trainer = SpLMTrainer(model_cfg, mesh, learning_rate=3e-3)
+        rng = np.random.default_rng(cfg.data.seed)
+        B = max(cfg.data.batch_size // 256, 1)
+        losses = []
+        for _ in range(cfg.steps):
+            base = rng.integers(0, model_cfg.vocab_size, size=(B, 1))
+            tokens = (base + np.arange(seq)[None]) % model_cfg.vocab_size
+            losses.append(trainer.step(tokens.astype(np.int32)))
+        return {"losses": losses, "steps": cfg.steps, "seq": seq}
+
+    return run
+
+
 @register_app("async_lr")
 def _build_async_lr(cfg: AppConfig) -> Callable[[], dict]:
     """Classic PS topology on one host: scheduler + servers + worker threads

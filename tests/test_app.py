@@ -186,3 +186,26 @@ def test_sparse_lr_app_trains_from_files_local_and_remote(tmp_path):
         srv.stop()
     # identical shards, identical stream order -> identical trajectories
     np.testing.assert_allclose(remote["losses"], local["losses"], rtol=1e-6)
+
+
+def test_sp_lm_app_runs_from_config():
+    """The long-context SP trainer is reachable from the config-driven app
+    surface (psx run)."""
+    from parameter_server_tpu import app as app_lib
+
+    from parameter_server_tpu.config import OptimizerConfig, TableConfig
+
+    cfg = app_lib.AppConfig(
+        app="sp_lm",
+        table=TableConfig(
+            name="emb", rows=256, dim=1,
+            optimizer=OptimizerConfig(kind="adagrad"),
+        ),
+        data=app_lib.DataConfig(kind="synthetic", key_space=256, nnz=2,
+                                batch_size=512, seed=0),
+        steps=2,
+    )
+    result = app_lib.create(cfg)()
+    assert result["steps"] == 2
+    assert np.all(np.isfinite(result["losses"]))
+    assert result["seq"] % 8 == 0  # divisible by the 8-device mesh
