@@ -208,3 +208,42 @@ def test_promotion_preserves_optimizer_state():
                 )
     finally:
         van.close()
+
+
+def test_replica_forwarding_rides_real_sockets():
+    """The chain protocol is Van-agnostic: a primary on the native TcpVan
+    forwards applied pushes to a standby over REAL sockets (the DCN shape —
+    promotion there is a route-table update, see kv/replica.py docstring)."""
+    from parameter_server_tpu import native
+
+    if native.load("tcpvan") is None:  # pragma: no cover
+        pytest.skip("no native toolchain for tcpvan")
+    from parameter_server_tpu.core.tcp_van import TcpVan
+
+    van_w, van_p, van_r = TcpVan(), TcpVan(), TcpVan()
+    try:
+        cfgs = _table_cfgs()
+        standby = KVServer(Postoffice("R0", van_r), cfgs, 0, 1)
+        primary = KVServer(
+            Postoffice("S0", van_p), cfgs, 0, 1,
+            replica="R0", replica_sync=True,
+        )
+        van_p.add_route("R0", van_r.address)
+        van_w.add_route("S0", van_p.address)
+        worker = KVWorker(Postoffice("W0", van_w), cfgs, 1)
+        keys, labels = _batches()[0]
+        w_pos = worker.pull_sync("w", keys, timeout=30)
+        g, _gb, _loss = linear.grad_rows(
+            jnp.asarray(w_pos), jnp.asarray(labels)
+        )
+        ts = worker.push("w", keys, np.asarray(g) / labels.shape[0])
+        assert worker.wait(ts, timeout=30)
+        np.testing.assert_array_equal(
+            np.asarray(primary.tables["w"].value),
+            np.asarray(standby.tables["w"].value),
+        )
+        assert van_p.bytes_sent() > 0  # the forward crossed a socket
+    finally:
+        van_w.close()
+        van_p.close()
+        van_r.close()
