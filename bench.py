@@ -691,6 +691,22 @@ def run_hybrid() -> tuple[dict, str]:
 # ---------------------------------------------------------------------------
 
 
+#: --llama8b feasibility grid: (mesh, batch, seq, remat, loss_chunk, fsdp,
+#: scan_blocks) per row.  Module scope so the mode watchdog is sized from
+#: len() of the REAL grid — a duplicate length constant silently undersized
+#: the watchdog once already (ADVICE r4).
+_LLAMA8B_GRID = [
+    ("2,8", 8, 2048, True, 512, "state", True),  # the fitting recipe
+    ("2,8", 8, 2048, True, 512, "none", True),  # moments replicated
+    ("2,8", 4, 2048, False, 0, "none", False),  # naive unrolled
+]
+#: per-subprocess timeout, plus part (b)'s emb-plane budget (~13 blocking
+#: van ops x 120 s per-op timeout + compile margin); the watchdog must
+#: cover every subprocess running to its own timeout AND the plane section
+_LLAMA8B_SUBPROC_TIMEOUT_S = 1800.0
+_LLAMA8B_EMBPLANE_BUDGET_S = 2400.0
+
+
 def _feasibility_subprocess(
     mesh, batch, seq, remat, loss_chunk, fsdp, scan=True
 ) -> dict:
@@ -710,7 +726,8 @@ def _feasibility_subprocess(
         "--scan-blocks" if scan else "--no-scan-blocks",
     ]
     out = subprocess.run(
-        cmd, capture_output=True, text=True, env=env, timeout=1800
+        cmd, capture_output=True, text=True, env=env,
+        timeout=_LLAMA8B_SUBPROC_TIMEOUT_S,
     )
     if out.returncode != 0:
         return {"error": (out.stderr or "")[-300:]}
@@ -731,14 +748,8 @@ def run_llama8b() -> tuple[dict, list[str]]:
     backend = jax.default_backend()
     lines = []
     # -- (a) memory table (CPU-sim subprocesses; backend-independent) -------
-    grid = [
-        # (mesh, batch, seq, remat, loss_chunk, fsdp, scan_blocks)
-        ("2,8", 8, 2048, True, 512, "state", True),  # the fitting recipe
-        ("2,8", 8, 2048, True, 512, "none", True),  # moments replicated
-        ("2,8", 4, 2048, False, 0, "none", False),  # naive unrolled
-    ]
     mem_rows = []
-    for mesh, batch, seq, remat, chunk, fsdp, scan in grid:
+    for mesh, batch, seq, remat, chunk, fsdp, scan in _LLAMA8B_GRID:
         r = _feasibility_subprocess(
             mesh, batch, seq, remat, chunk, fsdp, scan
         )
@@ -1388,8 +1399,16 @@ def main() -> None:
     elif crossover_mode:
         _start_watchdog("lr_rows_vs_dense_crossover", "log2(rows)")
     elif llama8b_mode:
-        # three multi-minute XLA compiles ride inside this mode
-        _start_watchdog("llama8b_fits_v5e16", "bool", default_s=2400.0)
+        # the watchdog must outlast the mode's worst-case LEGITIMATE budget:
+        # every feasibility subprocess can run to its own timeout AND the
+        # emb-plane section's per-op timeouts can all be consumed before
+        # anything is stuck (ADVICE r4 — 2400 s undercut the 3 x 1800 s
+        # grid and could kill a slow-but-progressing run)
+        _start_watchdog(
+            "llama8b_fits_v5e16", "bool",
+            default_s=len(_LLAMA8B_GRID) * _LLAMA8B_SUBPROC_TIMEOUT_S
+            + _LLAMA8B_EMBPLANE_BUDGET_S,
+        )
     else:
         _start_watchdog(
             "criteo_sparse_lr_async_sgd_throughput", "examples/sec/chip"

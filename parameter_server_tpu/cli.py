@@ -20,6 +20,8 @@ import sys
 
 import numpy as np
 
+from parameter_server_tpu.core.filters import DEFAULT_SPEC
+
 
 def _cmd_run(args: argparse.Namespace) -> int:
     from parameter_server_tpu import app as app_lib
@@ -154,11 +156,11 @@ def build_parser() -> argparse.ArgumentParser:
     la.add_argument("--batch-size", type=int, default=256)
     la.add_argument("--ckpt-root", default=None)
     la.add_argument(
-        "--filters", default="full",
-        help="wire filter stack on the TcpVan: 'none' to opt out, 'full' "
-        "(=key_caching+int8+zlib, default — codecs ship on, as the "
-        "reference's do), or a '+'-joined subset of "
-        "{key_caching, int8, zlib, noise}",
+        "--filters", default=DEFAULT_SPEC,
+        help="wire filter stack on the TcpVan: 'none' to opt out, "
+        "'lossless' (=key_caching+zlib, default — bit-exact wire), 'full' "
+        "(adds the LOSSY int8 quantizer; explicit opt-in), or a "
+        "'+'-joined subset of {key_caching, int8, zlib, noise}",
     )
     la.set_defaults(fn=_cmd_launch)
 
@@ -199,7 +201,7 @@ def build_parser() -> argparse.ArgumentParser:
                     "mode, the default — matches launch_hybrid()); "
                     "--no-bsp enables the SSP overlap shape")
     hy.add_argument("--max-delay", type=int, default=2)
-    hy.add_argument("--filters", default="full")
+    hy.add_argument("--filters", default=DEFAULT_SPEC)
     hy.set_defaults(fn=_cmd_launch_hybrid)
     return p
 

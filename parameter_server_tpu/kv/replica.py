@@ -97,8 +97,13 @@ def promote(van: Van, standby: KVServer, primary_id: str) -> KVServer:
         van.unbind(primary_id)  # drop the dead primary's endpoint, if any
     except Exception:  # noqa: BLE001 — already gone is fine
         pass
-    van.bind(primary_id, post._on_recv)
+    # identity BEFORE endpoint (ADVICE r4): a request landing in the bind ->
+    # node_id window would be answered under the old R{i} sender id, which
+    # breaks workers' in-flight pull/push bookkeeping (replies must carry
+    # primary_id, as promised above).  The old endpoint is unbound right
+    # after, so misdirected old-endpoint replies are not a concern.
     post.node_id = primary_id
+    van.bind(primary_id, post._on_recv)
     van.unbind(old_id)
     # fault-injection vans blackhole traffic by node id (the dead process's
     # socket); the promoted standby re-opens the identity

@@ -30,6 +30,8 @@ from typing import Optional
 
 import numpy as np
 
+from parameter_server_tpu.core.filters import DEFAULT_SPEC
+
 
 def _free_port() -> int:
     s = socket.socket()
@@ -214,7 +216,7 @@ def launch(
     batch_size: int = 256,
     nnz: int = 8,
     ckpt_root: Optional[str] = None,
-    filters: str = "full",
+    filters: str = DEFAULT_SPEC,
     run_timeout: float = 300.0,
     python: str = sys.executable,
 ) -> dict:
@@ -322,11 +324,11 @@ def main(argv=None) -> int:
     p.add_argument("--outdir", default=None)
     p.add_argument("--ckpt-root", default=None)
     p.add_argument(
-        "--filters", default="full",
-        help="wire filter stack on the TcpVan: 'none', 'full' "
-        "(=key_caching+int8+zlib, the default — the reference ships its "
-        "codecs on), or a '+'-separated pipeline over "
-        "{key_caching, int8, zlib, noise}",
+        "--filters", default=DEFAULT_SPEC,
+        help="wire filter stack on the TcpVan: 'none', 'lossless' "
+        "(=key_caching+zlib, the default — bit-exact wire), 'full' "
+        "(adds the LOSSY int8 quantizer; explicit opt-in), or a "
+        "'+'-separated pipeline over {key_caching, int8, zlib, noise}",
     )
     p.add_argument("--heartbeat-timeout", type=float, default=30.0)
     p.add_argument("--run-timeout", type=float, default=300.0)

@@ -38,6 +38,8 @@ import tempfile
 import time
 from typing import Optional
 
+from parameter_server_tpu.core.filters import DEFAULT_SPEC
+
 from parameter_server_tpu.launch import (
     _build_cluster,
     _free_port,
@@ -194,7 +196,7 @@ def launch_hybrid(
     bsp: bool = True,
     max_delay: int = 2,
     seed: int = 0,
-    filters: str = "full",
+    filters: str = DEFAULT_SPEC,
     run_timeout: float = 300.0,
     python: str = sys.executable,
 ) -> dict:
@@ -313,7 +315,7 @@ def main(argv=None) -> int:
                    default=True)
     p.add_argument("--max-delay", type=int, default=2)
     p.add_argument("--seed", type=int, default=0)
-    p.add_argument("--filters", default="full")
+    p.add_argument("--filters", default=DEFAULT_SPEC)
     p.add_argument("--outdir", default=None)
     p.add_argument("--heartbeat-timeout", type=float, default=30.0)
     p.add_argument("--run-timeout", type=float, default=300.0)

@@ -184,6 +184,16 @@ class HybridLMTrainer:
             r = shard.index[0]
             start = 0 if r.start is None else int(r.start)
             stop = arr.shape[0] if r.stop is None else int(r.stop)
+            # a non-process-major data-axis layout would put addressable
+            # rows OUTSIDE this process's slice; the Python slice below
+            # would then silently write wrong rows — fail loudly instead
+            # (ADVICE r4)
+            if not (sl.start <= start and stop <= sl.stop):
+                raise AssertionError(
+                    f"addressable shard rows [{start}, {stop}) fall outside "
+                    f"this process's batch slice [{sl.start}, {sl.stop}) — "
+                    "mesh data-axis layout is not process-major"
+                )
             out[start - sl.start : stop - sl.start] = np.asarray(shard.data)
         return out
 

@@ -28,7 +28,7 @@ from typing import Optional, Sequence
 V5E_HBM_BYTES = 16 * 1024**3
 
 
-def body_train_step_memory(
+def compile_body_step(
     cfg,
     mesh,
     batch: int,
@@ -37,21 +37,14 @@ def body_train_step_memory(
     learning_rate: float = 1e-3,
     loss_chunk: int = 0,
     fsdp: str = "none",
-) -> dict:
-    """Per-device memory analysis of the hybrid body train step.
+):
+    """AOT-compile one hybrid-body train step; returns (compiled, inputs).
 
-    Returns XLA's compiled memory breakdown (bytes, per device) for one
-    ``HybridLMTrainer``-shaped step: loss+grads w.r.t. (params, emb_in),
-    adamw update, batch sharded over ``data``, params TP-sharded over
-    ``model`` (``parallel/tp.py`` rules).
-
-    ``loss_chunk > 0`` fuses the lm_head into a rematerialized chunked loss
-    (``chunked_causal_lm_loss``) instead of materializing full logits.
-    ``fsdp``: ``"none"`` = TP shardings only; ``"full"`` = params AND
-    moments data-sharded (measured: GSPMD hoists the param all-gather out
-    of the layer scan, so the gathered stack reappears as a temp — little
-    net win); ``"state"`` = moments-only data sharding (the elementwise
-    adamw update needs no gather, so the saving is real).
+    ``inputs`` is the (params, opt_state, emb, tokens) tuple of
+    ``ShapeDtypeStruct``s (sharding-annotated) the compiled step expects —
+    the validator tool materializes real arrays against them to compare
+    ``memory_analysis()`` with the allocator's actual high-water
+    (VERDICT r4 weak #7).
     """
     import jax
     import jax.numpy as jnp
@@ -131,9 +124,44 @@ def body_train_step_memory(
     step = jax.jit(step_fn, donate_argnums=(0, 1))
     with mesh:
         compiled = step.lower(params_in, opt_in, emb_in, tokens).compile()
+    return compiled, (params_in, opt_in, emb_in, tokens)
+
+
+def body_train_step_memory(
+    cfg,
+    mesh,
+    batch: int,
+    seq: int,
+    *,
+    learning_rate: float = 1e-3,
+    loss_chunk: int = 0,
+    fsdp: str = "none",
+) -> dict:
+    """Per-device memory analysis of the hybrid body train step.
+
+    Returns XLA's compiled memory breakdown (bytes, per device) for one
+    ``HybridLMTrainer``-shaped step: loss+grads w.r.t. (params, emb_in),
+    adamw update, batch sharded over ``data``, params TP-sharded over
+    ``model`` (``parallel/tp.py`` rules).
+
+    ``loss_chunk > 0`` fuses the lm_head into a rematerialized chunked loss
+    (``chunked_causal_lm_loss``) instead of materializing full logits.
+    ``fsdp``: ``"none"`` = TP shardings only; ``"full"`` = params AND
+    moments data-sharded (measured: GSPMD hoists the param all-gather out
+    of the layer scan, so the gathered stack reappears as a temp — little
+    net win); ``"state"`` = moments-only data sharding (the elementwise
+    adamw update needs no gather, so the saving is real).
+    """
+    import jax
+    import numpy as np
+
+    compiled, (params_in, _opt_in, _emb_in, _tokens) = compile_body_step(
+        cfg, mesh, batch, seq,
+        learning_rate=learning_rate, loss_chunk=loss_chunk, fsdp=fsdp,
+    )
     ma = compiled.memory_analysis()
     n_params = sum(
-        int(jnp.prod(jnp.asarray(s.shape))) for s in jax.tree.leaves(param_shapes)
+        int(np.prod(s.shape)) for s in jax.tree.leaves(params_in)
     )
     out = {
         "n_body_params": n_params,

@@ -158,6 +158,15 @@ class SpLMTrainer:
         B, S = tokens.shape
         if S % self.n_shards:
             raise ValueError(f"seq {S} % sp shards {self.n_shards} != 0")
+        # the dense path raises on S > max_seq inside _apply_body, but the
+        # positions-given (SP) path cannot — jnp.take silently clips, which
+        # would train learned positionals on corrupted rows (ADVICE r4).
+        # The trainer knows the GLOBAL sequence here; validate it.
+        if self.cfg.positional == "learned" and S > self.cfg.max_seq:
+            raise ValueError(
+                f"global sequence {S} exceeds learned-positional "
+                f"max_seq {self.cfg.max_seq}"
+            )
         targets = np.concatenate(
             [tokens[:, 1:], np.zeros((B, 1), np.int32)], axis=1
         )

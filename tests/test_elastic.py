@@ -103,6 +103,14 @@ def test_worker_death_reassigns_and_completes():
             f"run incomplete: {trainer.pool.num_done()}/{len(trainer.pool)}"
         )
         assert trainer.pool.all_done()
+        # death detection is asynchronous to completion: under suite load
+        # the survivors can finish every workload before a sweep crosses
+        # the victim's 0.3 s silence window — keep sweeping until the
+        # detector fires rather than racing it (VERDICT r4 weak #6)
+        detect_deadline = time.monotonic() + 10
+        while sched.is_alive(victim) and time.monotonic() < detect_deadline:
+            sched.check_heartbeats()
+            time.sleep(0.05)
         assert not sched.is_alive(victim)
         # the victim's unfinished workloads were completed by survivors
         completed_by = {

@@ -139,6 +139,11 @@ def test_make_chain_specs():
     assert [type(f) for f in full.filters] == [
         KeyCachingFilter, FixingFloatFilter, CompressingFilter,
     ]
+    # the launcher default: bit-exact on the wire, no int8 (ADVICE r4)
+    lossless = make_chain("lossless")
+    assert [type(f) for f in lossless.filters] == [
+        KeyCachingFilter, CompressingFilter,
+    ]
     custom = make_chain("noise+zlib")
     assert [type(f) for f in custom.filters] == [
         AddNoiseFilter, CompressingFilter,

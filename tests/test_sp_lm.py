@@ -109,6 +109,14 @@ def test_sp_trainer_rejects_bad_configs():
     sp = SpLMTrainer(_cfg(), _sp_mesh(8))
     with pytest.raises(ValueError, match="sp shards"):
         sp.step(np.zeros((2, 60), np.int32))  # 60 % 8 != 0
+    # learned positionals + global seq > max_seq must fail LOUDLY at the
+    # trainer (the positions-given path in _apply_body cannot raise and
+    # jnp.take would silently clip — ADVICE r4)
+    lp = SpLMTrainer(
+        _cfg(positional="learned", norm="ln", max_seq=32), _sp_mesh(8)
+    )
+    with pytest.raises(ValueError, match="max_seq"):
+        lp.step(np.zeros((2, 64), np.int32))  # 64 > max_seq 32
 
 
 def test_sp_composes_with_dp():
