@@ -28,6 +28,23 @@ from typing import Optional, Sequence
 V5E_HBM_BYTES = 16 * 1024**3
 
 
+def peak_bytes_from_analysis(ma) -> int:
+    """Live-at-peak per device from XLA's ``memory_analysis()``.
+
+    arguments (params+opt+batch; donation aliases the outputs onto them)
+    + temps + generated code; alias_bytes is the donated overlap counted
+    inside argument_bytes, not extra.  ONE definition, shared by the
+    feasibility table and ``tools/validate_peak_bytes.py`` — the validator
+    must calibrate the formula the table actually ships.
+    """
+    return (
+        int(ma.argument_size_in_bytes)
+        + int(ma.temp_size_in_bytes)
+        + int(ma.generated_code_size_in_bytes)
+        + max(int(ma.output_size_in_bytes) - int(ma.alias_size_in_bytes), 0)
+    )
+
+
 def compile_body_step(
     cfg,
     mesh,
@@ -178,15 +195,7 @@ def body_train_step_memory(
         "alias_bytes": int(ma.alias_size_in_bytes),
         "generated_code_bytes": int(ma.generated_code_size_in_bytes),
     }
-    # live-at-peak per device: arguments (params+opt+batch, donation aliases
-    # the outputs onto them) + temps + generated code; alias_bytes is the
-    # donated overlap counted inside argument_bytes, not extra
-    out["peak_bytes"] = (
-        out["argument_bytes"]
-        + out["temp_bytes"]
-        + out["generated_code_bytes"]
-        + max(out["output_bytes"] - out["alias_bytes"], 0)
-    )
+    out["peak_bytes"] = peak_bytes_from_analysis(ma)
     out["fits_v5e"] = out["peak_bytes"] <= V5E_HBM_BYTES
     return out
 

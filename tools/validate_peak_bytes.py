@@ -37,7 +37,10 @@ def main() -> int:
 
     from parameter_server_tpu.models import transformer as tfm
     from parameter_server_tpu.parallel import mesh as mesh_lib
-    from parameter_server_tpu.parallel.feasibility import compile_body_step
+    from parameter_server_tpu.parallel.feasibility import (
+        compile_body_step,
+        peak_bytes_from_analysis,
+    )
 
     backend = jax.default_backend()
     dev = jax.devices()[0]
@@ -55,12 +58,7 @@ def main() -> int:
     )
     compile_s = time.perf_counter() - t0
     ma = compiled.memory_analysis()
-    predicted = (
-        int(ma.argument_size_in_bytes)
-        + int(ma.temp_size_in_bytes)
-        + int(ma.generated_code_size_in_bytes)
-        + max(int(ma.output_size_in_bytes) - int(ma.alias_size_in_bytes), 0)
-    )
+    predicted = peak_bytes_from_analysis(ma)
 
     def materialize(tree):
         return jax.tree.map(
