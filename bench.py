@@ -700,38 +700,56 @@ _LLAMA8B_GRID = [
     ("2,8", 8, 2048, True, 512, "none", True),  # moments replicated
     ("2,8", 4, 2048, False, 0, "none", False),  # naive unrolled
 ]
+#: the composed long-context grid (VERDICT r4 #5): ``SpTpLMTrainer``'s
+#: step — ring attention over sp x TP over model x moments-FSDP —
+#: AOT-analyzed at long sequences.  (mesh, devices, batch, seq, dtype).
+_LLAMA8B_SP_GRID = [
+    ("2,8", 16, 1, 8192, None),      # FITS a v5e-16 (measured 13.6 GiB)
+    ("2,8", 16, 1, 16384, None),     # the 16-chip wall (~19.4 GiB)
+    ("4,8", 32, 1, 16384, None),     # 16k fits 32 chips
+]
 #: per-subprocess timeout, plus part (b)'s emb-plane budget (~13 blocking
-#: van ops x 120 s per-op timeout + compile margin); the watchdog must
-#: cover every subprocess running to its own timeout AND the plane section
+#: van ops x 120 s per-op timeout + compile margin) and part (c)'s
+#: overlapped sweep (3 runs x ~15 ops x the plane's own 120 s per-op
+#: timeout + body windows); the watchdog must cover every section running
+#: to its own per-op timeouts simultaneously
 _LLAMA8B_SUBPROC_TIMEOUT_S = 1800.0
 _LLAMA8B_EMBPLANE_BUDGET_S = 2400.0
+_LLAMA8B_OVERLAP_BUDGET_S = 3 * (15 * 120.0 + 30.0)
+
+
+def _cpu_sim_subprocess(
+    module: str, cli: list[str], *, devices: int, timeout_s: float
+) -> dict:
+    """Run a CPU-sim proof step in a fresh process (the virtual topology
+    must be fixed before jax initializes) and parse its JSON line."""
+    env = dict(os.environ)
+    root = os.path.dirname(os.path.abspath(__file__))
+    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={devices}"
+    out = subprocess.run(
+        [sys.executable, "-m", module, *cli],
+        capture_output=True, text=True, env=env, timeout=timeout_s,
+    )
+    if out.returncode != 0:
+        return {"error": (out.stderr or "")[-300:]}
+    return json.loads(out.stdout.strip().splitlines()[-1])
 
 
 def _feasibility_subprocess(
     mesh, batch, seq, remat, loss_chunk, fsdp, scan=True
 ) -> dict:
-    """Run the AOT memory analysis in a fresh CPU process (the 16-device
-    virtual topology must be fixed before jax initializes)."""
-    env = dict(os.environ)
-    root = os.path.dirname(os.path.abspath(__file__))
-    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=16"
-    cmd = [
-        sys.executable, "-m", "parameter_server_tpu.parallel.feasibility",
-        "--mesh", mesh, "--batch", str(batch), "--seq", str(seq),
-        "--loss-chunk", str(loss_chunk),
-        "--remat" if remat else "--no-remat",
-        "--fsdp", fsdp,
-        "--scan-blocks" if scan else "--no-scan-blocks",
-    ]
-    out = subprocess.run(
-        cmd, capture_output=True, text=True, env=env,
-        timeout=_LLAMA8B_SUBPROC_TIMEOUT_S,
+    return _cpu_sim_subprocess(
+        "parameter_server_tpu.parallel.feasibility",
+        ["--mesh", mesh, "--batch", str(batch), "--seq", str(seq),
+         "--loss-chunk", str(loss_chunk),
+         "--remat" if remat else "--no-remat",
+         "--fsdp", fsdp,
+         "--scan-blocks" if scan else "--no-scan-blocks"],
+        devices=16,
+        timeout_s=_LLAMA8B_SUBPROC_TIMEOUT_S,
     )
-    if out.returncode != 0:
-        return {"error": (out.stderr or "")[-300:]}
-    return json.loads(out.stdout.strip().splitlines()[-1])
 
 
 def run_llama8b() -> tuple[dict, list[str]]:
@@ -762,6 +780,29 @@ def run_llama8b() -> tuple[dict, list[str]]:
                 f"8b mem mesh={mesh} b={batch} remat={remat} chunk={chunk} "
                 f"fsdp={fsdp} scan={scan}: "
                 f"peak={r['peak_bytes'] / 1e9:.2f} GB/device "
+                f"fits_v5e={r['fits_v5e']}"
+            )
+
+    # -- (a2) the composed LONG-CONTEXT grid (VERDICT r4 #5): SpTpLMTrainer
+    # (ring_spmd x TP x moments-FSDP x scan+remat+chunked loss) ------------
+    sp_rows = []
+    for mesh, devs, batch, seq, dtype in _LLAMA8B_SP_GRID:
+        cli = ["--preset", "llama3-8b-sp", "--mesh", mesh,
+               "--batch", str(batch), "--seq", str(seq)]
+        if dtype:
+            cli += ["--dtype", dtype]
+        r = _cpu_sim_subprocess(
+            "parameter_server_tpu.parallel.feasibility", cli,
+            devices=devs, timeout_s=_LLAMA8B_SUBPROC_TIMEOUT_S,
+        )
+        r.update(mesh_cfg=mesh, batch=batch, seq=seq)
+        sp_rows.append(r)
+        if "error" in r:
+            lines.append(f"8b SP mesh={mesh} seq={seq} FAILED: {r['error'][:120]}")
+        else:
+            lines.append(
+                f"8b SP mesh=({mesh}) seq={seq} ring_spmd fsdp=state: "
+                f"peak={r['peak_bytes'] / 2**30:.2f} GiB/device "
                 f"fits_v5e={r['fits_v5e']}"
             )
 
@@ -840,6 +881,55 @@ def run_llama8b() -> tuple[dict, list[str]]:
     finally:
         van.close()
 
+    # -- (c) the PRODUCTION plane shape (VERDICT r4 weak #4): real sockets,
+    # int8+key-cache codecs, device-resident replies, prefetch overlapped
+    # against a synthetic body window (config #5's body runs on chips the
+    # plane never touches; its wall time is a sleep here).  The sweep over
+    # body windows separates the plane's SERIAL work from what overlap
+    # hides; the codec microbench attributes it; the cores-needed figure
+    # projects the <=10% target onto real multi-core server hosts --------
+    try:
+        sweep = [
+            _emb_plane_overlapped(
+                VOCAB=VOCAB, D=D, B=B, S=S, steps=steps, t_body_s=tb,
+                filters="key_caching+int8",
+            )
+            for tb in (1.0, 2.0, 4.0)
+        ]
+        codec = _plane_codec_microbench(D=D)
+        # serial plane work per step: best (exposure + window) over the
+        # sweep — the least-contended estimate this 1-core host can give
+        w_serial_ms = min(
+            r["exposure_ms_median"] + r["t_body_ms"] for r in sweep
+        )
+        body_v5e_ms = 1400.0  # 6*8e9*32k tok / (16 chips x 197TF x 0.35)
+        cores_for_10pct = int(
+            np.ceil(w_serial_ms / (0.10 * body_v5e_ms))
+        )
+        overlapped = {
+            "filters": "key_caching+int8",
+            "sweep": sweep,
+            "codec_ms": codec,
+            "plane_serial_ms_per_step": round(w_serial_ms, 0),
+            "body_v5e_ms_assumed": body_v5e_ms,
+            "plane_cores_for_10pct": cores_for_10pct,
+        }
+        for r in sweep:
+            lines.append(
+                f"8b emb plane OVERLAPPED (int8+kc, body {r['t_body_ms']:.0f}"
+                f" ms): exposure {r['exposure_ms_median']} ms "
+                f"({r['exposure_pct_of_body']}%), wire "
+                f"{r['wire_mb_per_step']} MB/step"
+            )
+        lines.append(
+            f"8b emb plane serial work ~{w_serial_ms:.0f} ms/step on ONE "
+            f"core; <=10% of a {body_v5e_ms:.0f} ms body needs ~"
+            f"{cores_for_10pct} plane cores (codec: {codec})"
+        )
+    except Exception as e:  # noqa: BLE001 — part (c) must not kill (a)+(b)
+        overlapped = {"error": f"{type(e).__name__}: {e}"[:300]}
+        lines.append(f"8b emb plane OVERLAPPED failed: {overlapped['error']}")
+
     fits = [r for r in mem_rows if r.get("fits_v5e")]
     record = {
         "metric": "llama8b_fits_v5e16",
@@ -848,9 +938,235 @@ def run_llama8b() -> tuple[dict, list[str]]:
         "vs_baseline": None,
         "backend": backend,
         "memory_grid": mem_rows,
+        "sp_grid": sp_rows,
         "emb_plane": emb,
+        "emb_plane_overlapped": overlapped,
     }
     return record, lines
+
+
+def _sp_grid_md(sp_rows: list[dict]) -> str:
+    """BASELINE.md block for the composed long-context grid."""
+    if not sp_rows:
+        return ""
+    rows = ""
+    for r in sp_rows:
+        if "error" in r:
+            rows += f"| ({r.get('mesh_cfg')}) sp x tp | — | — | — | — | ERROR |\n"
+            continue
+        n_dev = r["mesh"]["sp"] * r["mesh"]["model"]
+        rows += (
+            f"| ({r['mesh_cfg']}) sp x tp, {n_dev} chips | "
+            f"{r['batch']}x{r['seq']} | ring_spmd scan+remat "
+            f"chunk={r['loss_chunk']} fsdp=state/sp | "
+            f"{r['argument_bytes'] / 2**30:.2f} | "
+            f"{r['temp_bytes'] / 2**30:.2f} | "
+            f"**{r['peak_bytes'] / 2**30:.2f} GiB** "
+            f"{'FITS' if r['fits_v5e'] else 'OVER'} |\n"
+        )
+    ok = [r for r in sp_rows if "error" not in r]
+    verdicts = "; ".join(
+        f"seq {r['seq']} on {r['mesh']['sp'] * r['mesh']['model']} chips: "
+        f"{'FITS' if r['fits_v5e'] else 'OVER'} "
+        f"({r['peak_bytes'] / 2**30:.2f} GiB)"
+        for r in ok
+    )
+    over = [r for r in ok if not r["fits_v5e"]]
+    wall_note = (
+        "  Where it is OVER, the wall is temps (scan-saved residual stack "
+        "+ ring working set), not params/optimizer — args stay "
+        f"{over[0]['argument_bytes'] / 2**30:.1f} GiB there."
+        if over
+        else ""
+    )
+    return (
+        "\n**Composed long-context (`SpTpLMTrainer`: ring attention over "
+        "`sp` via PARTIAL shard_map x TP over `model` x moments-FSDP over "
+        "`sp` x scan+remat+per-shard chunked loss; args/temps in GiB; "
+        "16 GiB = v5e budget):**\n\n"
+        "| mesh | batch x seq | knobs | args GiB | temps GiB | peak/device |\n"
+        "|---|---|---|---|---|---|\n" + rows +
+        f"\nMeasured verdicts: {verdicts}.{wall_note}  Trajectory-parity "
+        "with the dense trainer: tests/test_sp_fsdp.py.\n"
+    )
+
+
+def _overlapped_md(ov: dict) -> str:
+    """BASELINE.md paragraph for the overlapped plane sweep (part c)."""
+    if not ov or "error" in ov:
+        return ""
+    rows = "".join(
+        f"| {r['t_body_ms']:.0f} | {r['exposure_ms_median']} | "
+        f"{r['exposure_pct_of_body']}% | {r['wire_mb_per_step']} |\n"
+        for r in ov["sweep"]
+    )
+    c = ov["codec_ms"]
+    first = ov["sweep"][0]
+    raw_mb = 2 * first["raw_row_mb_per_step"]
+    ratio = raw_mb / max(first["wire_mb_per_step"], 1e-9)
+    hosts16 = int(np.ceil(ov["plane_cores_for_10pct"] / 16))
+    return (
+        "\n**Overlapped plane (production shape — TcpVan sockets, "
+        f"`{ov['filters']}` codecs, device replies, prefetched pull + "
+        "bounded-delay push, synthetic body window = sleep):**\n\n"
+        "| body window ms | plane exposure ms | % of body | wire MB/step |\n"
+        "|---|---|---|---|\n" + rows +
+        f"\nint8+key-cache cuts wire to ~{first['wire_mb_per_step']}"
+        f" MB/step from {raw_mb:.0f} MB raw ({ratio:.1f}x); zlib is "
+        "ANTI-productive after int8 at this shape "
+        f"(+{c['zlib_l1_ms']:.0f} ms/direction for "
+        f"-{c['zlib_saves_pct']}% — it stays default-on only for the small "
+        "mixed control/launch messages where it saves 40%).  The plane's "
+        f"SERIAL work is ~{ov['plane_serial_ms_per_step']:.0f} ms/step on "
+        f"this ONE-core host (codec {c['quantize_ms']:.0f}+"
+        f"{c['dequantize_ms']:.0f} ms/direction of {c['payload_mb']} MB + "
+        "gather/apply/wire); meeting the <=10%-of-step target against a "
+        f"~{ov['body_v5e_ms_assumed']:.0f} ms v5e-16 body step therefore "
+        f"needs ~{ov['plane_cores_for_10pct']} plane cores total — "
+        f"{hosts16} x 16-core server host(s) serving shards in parallel, "
+        "far inside config #5's 200-servers-per-800-workers ratio "
+        "(OSDI'14 [U]).  Per-shard work parallelizes trivially: each "
+        "server codecs and applies only its key range.\n"
+    )
+
+
+def _plane_codec_microbench(*, D: int, rows: int = 7500) -> dict:
+    """Per-direction codec cost at the 8B plane shape (one core, ms).
+
+    Pins down WHERE the plane's serial work goes — and why zlib is
+    anti-productive after int8 here (~1 s for −16% on 31 MB of int8
+    mantissa noise, vs its 40% win on small mixed launch messages).
+    """
+    import zlib as _zlib
+
+    from parameter_server_tpu.ops.quantize import dequantize_int8, quantize_int8
+
+    x = np.random.default_rng(0).normal(size=(rows, D)).astype(np.float32)
+    t0 = time.perf_counter()
+    q, scale = quantize_int8(x)
+    t1 = time.perf_counter()
+    dequantize_int8(q, scale)
+    t2 = time.perf_counter()
+    c = _zlib.compress(q.tobytes(), 1)
+    t3 = time.perf_counter()
+    return {
+        "rows": rows,
+        "payload_mb": round(x.nbytes / 1e6, 1),
+        "quantize_ms": round((t1 - t0) * 1e3, 0),
+        "dequantize_ms": round((t2 - t1) * 1e3, 0),
+        "zlib_l1_ms": round((t3 - t2) * 1e3, 0),
+        "zlib_saves_pct": round(100 * (1 - len(c) / q.nbytes), 1),
+    }
+
+
+def _emb_plane_overlapped(
+    *, VOCAB: int, D: int, B: int, S: int, steps: int, t_body_s: float,
+    filters: str = "key_caching+int8+zlib",
+) -> dict:
+    """The 8B embedding plane as deployed: overlapped, filtered, on sockets.
+
+    Plane servers are separate hosts in config #5 — their work overlaps the
+    chip body step entirely except the tail the worker actually waits on.
+    Shape: prefetch the NEXT step's pull before the body window opens, keep
+    ONE push in flight (bounded delay 1), and measure the EXPOSED plane time
+    (step wall minus the body window) that a real trainer would eat.
+    Codecs ride the real ``TcpVan`` frames, so wire bytes are actual socket
+    bytes after int8(-4x)+key-cache+zlib.
+    """
+    import jax as _jax
+
+    from parameter_server_tpu.config import OptimizerConfig, TableConfig
+    from parameter_server_tpu.core.filters import make_chain
+    from parameter_server_tpu.core.postoffice import Postoffice
+    from parameter_server_tpu.core.tcp_van import TcpVan
+    from parameter_server_tpu.kv.server import KVServer
+    from parameter_server_tpu.kv.worker import KVWorker
+    from parameter_server_tpu.utils.keys import IdentityLocalizer
+
+    n_servers = 2
+    cfgs = {
+        "emb": TableConfig(
+            name="emb", rows=VOCAB, dim=D,
+            # non-zero init: a zero table quantizes/compresses to ~nothing
+            # and would fake the wire measurement
+            init_scale=0.02,
+            optimizer=OptimizerConfig(kind="adagrad", learning_rate=0.05),
+        )
+    }
+    vans = [TcpVan(filter_chain=make_chain(filters)) for _ in range(n_servers + 1)]
+    van_w, van_s = vans[0], vans[1:]
+    try:
+        servers = []
+        for s in range(n_servers):
+            servers.append(
+                KVServer(
+                    Postoffice(f"S{s}", van_s[s]), cfgs, s, n_servers,
+                    device_replies=True,
+                )
+            )
+            van_w.add_route(f"S{s}", van_s[s].address)
+            van_s[s].add_route("W0", van_w.address)
+        worker = KVWorker(
+            Postoffice("W0", van_w), cfgs, n_servers,
+            localizers={"emb": IdentityLocalizer(VOCAB)},
+        )
+        rng = np.random.default_rng(0)
+        toks = [
+            (rng.zipf(1.2, size=(B, S)) % VOCAB).astype(np.int64)
+            for _ in range(steps + 2)
+        ]
+        # warmup: one full sync round (compiles gather/update programs)
+        ts = worker.pull("emb", toks[0])
+        rows = worker.pull_result_device(ts, timeout=120)
+        _jax.block_until_ready(rows)
+        g = rows.reshape(-1, D) * 0.01
+        worker.wait(worker.push_device("emb", toks[0].reshape(-1), g), 120)
+
+        sent0, recv0 = van_w.bytes_sent(), van_w.bytes_recv()
+        exposures = []
+        ts_cur = worker.pull("emb", toks[1])
+        pts_prev = None
+        t_all = time.perf_counter()
+        for i in range(1, steps + 1):
+            t0 = time.perf_counter()
+            # prefetch the NEXT step's rows before the body window opens
+            ts_next = worker.pull("emb", toks[i + 1])
+            time.sleep(t_body_s)  # the body step, on chips the plane
+            # never touches (sleep = lower bound on overlap opportunity)
+            rows = worker.pull_result_device(ts_cur, timeout=120)
+            _jax.block_until_ready(rows)
+            g = rows.reshape(-1, D) * 0.01
+            if pts_prev is not None and not worker.wait(pts_prev, 120):
+                raise TimeoutError("emb push not acked")
+            pts_prev = worker.push_device("emb", toks[i].reshape(-1), g)
+            ts_cur = ts_next
+            exposures.append(
+                (time.perf_counter() - t0 - t_body_s) * 1e3
+            )
+        if pts_prev is not None:
+            worker.wait(pts_prev, 120)
+        wall = time.perf_counter() - t_all
+        wire_mb = (
+            (van_w.bytes_sent() - sent0 + van_w.bytes_recv() - recv0)
+            / steps / 1e6
+        )
+        uniq = float(np.mean([len(np.unique(t)) for t in toks[1:-1]]))
+        exp_med = float(np.median(exposures))
+        return {
+            "filters": filters,
+            "t_body_ms": round(t_body_s * 1e3, 0),
+            "exposure_ms_median": round(exp_med, 1),
+            "exposure_ms": [round(x, 1) for x in exposures],
+            "exposure_pct_of_body": round(100 * exp_med / (t_body_s * 1e3), 1),
+            "wire_mb_per_step": round(wire_mb, 1),
+            "raw_row_mb_per_step": round(uniq * D * 4 / 1e6, 1),
+            "unique_rows_per_step": round(uniq, 0),
+            "tokens_per_sec_overlapped": round(B * S * steps / wall, 1),
+            "steps": steps,
+        }
+    finally:
+        for v in vans:
+            v.close()
 
 
 _L8B_BEGIN = "<!-- BENCH-LLAMA8B:BEGIN -->"
@@ -879,7 +1195,8 @@ def record_llama8b(record: dict, lines: list[str]) -> None:
         "memory per device from XLA's own analysis of the full train step "
         "(fwd+bwd+adamw) on a simulated (data, model) v5e-16 mesh:\n\n"
         "| mesh | batch x seq | knobs | args GB | temps GB | peak/device |\n"
-        "|---|---|---|---|---|---|\n" + rows_md +
+        "|---|---|---|---|---|---|\n" + rows_md
+        + _sp_grid_md(record.get("sp_grid", [])) +
         f"\nEmbedding plane at the 8B shape (vocab {emb['vocab']:,} x d "
         f"{emb['d_model']}, PS-served, device-resident replies, backend "
         f"`{emb['backend']}`): pull {emb['pull_ms']} ms + push "
@@ -887,6 +1204,7 @@ def record_llama8b(record: dict, lines: list[str]) -> None:
         f"zipf tokens = {emb['unique_rows_per_step']:.0f} unique rows "
         f"({emb['unique_row_mb_per_step']} MB x2 directions), "
         f"{emb['tokens_per_sec']:,.0f} tok/s through the plane alone.\n"
+        + _overlapped_md(record.get("emb_plane_overlapped", {}))
     )
     _splice_baseline(
         _L8B_BEGIN,
@@ -1070,6 +1388,150 @@ def record_ingest(record: dict, lines: list[str]) -> None:
         body,
         "## Host ingest: parser / reader / psfs rates vs chip demand "
         "(auto-recorded by bench.py --ingest)",
+    )
+
+
+# -- DLRM at scale: billion-row table proof (VERDICT r4 #3) ----------------
+
+_DLRM_SUBPROC_TIMEOUT_S = 1200.0
+
+
+def _dlrm_subprocess(module: str, cli: list[str], devices: int) -> dict:
+    return _cpu_sim_subprocess(
+        module, cli, devices=devices, timeout_s=_DLRM_SUBPROC_TIMEOUT_S
+    )
+
+
+def run_dlrm() -> tuple[dict, list[str]]:
+    """Billion-row DLRM (config #3) evidence, both halves (VERDICT r4 #3).
+
+    (a) AOT: the REAL ``SpmdDLRMTrainer`` step compiled over a simulated
+    v5e-16 with a 2^30-row x dim-16 table + adagrad rows (64 GB each,
+    never materialized) — per-device peak from XLA's memory_analysis.
+    (b) Stepped: a 2^28-row table (32 GiB value+state, 4 GiB/device)
+    ACTUALLY allocated row-sharded on the 8-dev mesh and trained for real
+    steps — per-step traffic stays O(touched rows), proving the step never
+    walks the table.
+    """
+    lines = []
+    aot = _dlrm_subprocess(
+        "parameter_server_tpu.parallel.feasibility",
+        ["--preset", "dlrm-1b", "--rows-log2", "30", "--dim", "16",
+         "--mesh", "1,16", "--batch", "8192"],
+        devices=16,
+    )
+    if "error" in aot:
+        lines.append(f"dlrm aot FAILED: {aot['error'][:200]}")
+    else:
+        lines.append(
+            f"dlrm aot 2^{aot['rows_log2']} x {aot['dim']} on (1,16): "
+            f"table {aot['table_bytes_per_device'] / 2**30:.2f} GiB/dev, "
+            f"peak {aot['peak_bytes'] / 2**30:.2f} GiB/dev, "
+            f"fits_v5e={aot['fits_v5e']}"
+        )
+    stepped = _dlrm_subprocess(
+        "parameter_server_tpu.parallel.dlrm_scale",
+        ["--rows-log2", "28", "--dim", "16", "--mesh", "1,8",
+         "--batch", "8192", "--steps", "4"],
+        devices=8,
+    )
+    if "error" in stepped:
+        lines.append(f"dlrm stepped FAILED: {stepped['error'][:200]}")
+    else:
+        lines.append(
+            f"dlrm stepped 2^{stepped['rows_log2']}: "
+            f"{stepped['table_gib']} GiB table "
+            f"({stepped['shard_gib_per_device']} GiB/dev), init "
+            f"{stepped['init_s']}s, step {stepped['step_ms_median']} ms "
+            f"median touching {stepped['touched_mb_per_step']} MB "
+            f"({stepped['unique_rows_per_step']:.0f} uniq rows), losses "
+            f"{stepped['losses']}"
+        )
+    # the O(touched)-not-O(table) claim needs its CONTROL measured in the
+    # same run: a 64x-smaller table at the same batch must step in ~the
+    # same time, or the step is secretly walking the table
+    small = _dlrm_subprocess(
+        "parameter_server_tpu.parallel.dlrm_scale",
+        ["--rows-log2", "22", "--dim", "16", "--mesh", "1,8",
+         "--batch", "8192", "--steps", "4"],
+        devices=8,
+    )
+    if "error" not in small and "error" not in stepped:
+        stepped["flatness_vs_2e22"] = round(
+            stepped["step_ms_median"] / max(small["step_ms_median"], 1e-9), 2
+        )
+        stepped["step_ms_median_2e22"] = small["step_ms_median"]
+        lines.append(
+            f"dlrm step-time flatness: 2^28 {stepped['step_ms_median']} ms "
+            f"vs 2^22 {small['step_ms_median']} ms = "
+            f"{stepped['flatness_vs_2e22']}x at a 64x larger table"
+        )
+    fits = bool(aot.get("fits_v5e")) and "error" not in stepped
+    record = {
+        "metric": "dlrm_1b_fits_v5e16",
+        "value": 1.0 if fits else 0.0,
+        "unit": "bool",
+        "vs_baseline": None,
+        "backend": "cpu-sim (AOT memory analysis + 8-dev virtual mesh)",
+        "aot_2e30": aot,
+        "stepped_2e28": stepped,
+    }
+    if not fits:
+        record["error"] = "; ".join(
+            x.get("error", "")[:150] for x in (aot, stepped) if "error" in x
+        ) or "aot reports fits_v5e false"
+    return record, lines
+
+
+_DLRM_BEGIN = "<!-- BENCH-DLRM:BEGIN -->"
+_DLRM_END = "<!-- BENCH-DLRM:END -->"
+
+
+def record_dlrm(record: dict, lines: list[str]) -> None:
+    stamp = time.strftime("%Y-%m-%d %H:%M:%S UTC", time.gmtime())
+    a, s = record["aot_2e30"], record["stepped_2e28"]
+    if "error" in a or "error" in s:
+        return
+    body = (
+        f"\n{stamp}.  Both halves of the billion-row claim (config #3):\n\n"
+        "**AOT (never materialized)** — the real `SpmdDLRMTrainer` step "
+        f"compiled over a simulated v5e-16 ((1,16) mesh), 2^{a['rows_log2']} "
+        f"rows x dim {a['dim']}, adagrad rows: value+state = "
+        f"{a['table_bytes_per_device'] * a['mesh']['model'] / 2**30:.0f} "
+        "GiB total, "
+        f"**{a['table_bytes_per_device'] / 2**30:.2f} GiB/device** table + "
+        f"{a['temp_bytes'] / 2**20:.0f} MiB temps -> peak "
+        f"**{a['peak_bytes'] / 2**30:.2f} GiB/device — "
+        f"{'FITS' if a['fits_v5e'] else 'DOES NOT FIT'}** a 16 GB v5e chip "
+        f"(XLA memory_analysis, batch {a['batch']}, "
+        f"2^{a['slots_log2']} slot bucket).\n\n"
+        "**Stepped (actually allocated)** — "
+        f"2^{s['rows_log2']} x {s['dim']} on the 8-dev mesh: "
+        f"{s['table_gib']} GiB value+state row-sharded at "
+        f"{s['shard_gib_per_device']} GiB/device, trained "
+        f"{len(s['losses'])} real steps (losses {s['losses']}): "
+        f"**{s['step_ms_median']} ms/step median touching only "
+        f"{s['touched_mb_per_step']} MB** "
+        f"({s['gathered_slots_per_step']:.0f} gathered slots — "
+        f"{s['unique_rows_per_step']:.0f} unique keys bucketed to a power "
+        "of two — x (value+adagrad) x read+write) — per-step traffic is "
+        "O(batch), never O(table)"
+        + (
+            f": measured control, the same batch on a 64x smaller 2^22 "
+            f"table steps at {s['step_ms_median_2e22']} ms "
+            f"({s['flatness_vs_2e22']}x)"
+            if "flatness_vs_2e22" in s
+            else ""
+        )
+        + ".  Billion-row tables are rows-mode territory sharded over the "
+        "model axis, exactly as the crossover table projects.\n"
+    )
+    _splice_baseline(
+        _DLRM_BEGIN,
+        _DLRM_END,
+        body,
+        "## DLRM at scale: billion-row table "
+        "(auto-recorded by bench.py --dlrm)",
     )
 
 
@@ -1666,6 +2128,34 @@ def main() -> None:
     hybrid_mode = "--hybrid" in sys.argv[1:]
     crossover_mode = "--crossover" in sys.argv[1:]
     llama8b_mode = "--llama8b" in sys.argv[1:]
+    if "--dlrm" in sys.argv[1:]:
+        # CPU-sim proofs in subprocesses: no TPU probe, no chip time
+        # three subprocesses: AOT 2^30, stepped 2^28, and the 2^22 control
+        _start_watchdog(
+            "dlrm_1b_fits_v5e16", "bool",
+            default_s=3 * _DLRM_SUBPROC_TIMEOUT_S + 300.0,
+        )
+        try:
+            record, lines = run_dlrm()
+        except Exception as e:  # noqa: BLE001 — the JSON line must still emit
+            _emit(
+                {
+                    "metric": "dlrm_1b_fits_v5e16",
+                    "value": 0.0,
+                    "unit": "bool",
+                    "vs_baseline": None,
+                    "error": f"dlrm failed: {type(e).__name__}: {e}"[:500],
+                }
+            )
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
+            return
+        _emit(record)
+        print("\n".join(lines), file=sys.stderr)
+        if not record.get("error"):
+            record_dlrm(record, lines)
+        return
     if "--tta" in sys.argv[1:]:
         # host-plane consistency experiment: CPU forced (see run_tta)
         from parameter_server_tpu.utils.platform import force_cpu
@@ -1735,8 +2225,10 @@ def main() -> None:
         # grid and could kill a slow-but-progressing run)
         _start_watchdog(
             "llama8b_fits_v5e16", "bool",
-            default_s=len(_LLAMA8B_GRID) * _LLAMA8B_SUBPROC_TIMEOUT_S
-            + _LLAMA8B_EMBPLANE_BUDGET_S,
+            default_s=(len(_LLAMA8B_GRID) + len(_LLAMA8B_SP_GRID))
+            * _LLAMA8B_SUBPROC_TIMEOUT_S
+            + _LLAMA8B_EMBPLANE_BUDGET_S
+            + _LLAMA8B_OVERLAP_BUDGET_S,
         )
     else:
         _start_watchdog(

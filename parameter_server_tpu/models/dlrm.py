@@ -72,6 +72,144 @@ class DLRM(nn.Module):
         return logits[:, 0]
 
 
+def make_dlrm_step(
+    table_cfg: TableConfig,
+    mesh: Mesh,
+    model: DLRM,
+    optimizer: ServerOptimizer,
+    tx,
+    n_sparse: int,
+):
+    """Build the jitted DLRM train step over a (data, model) mesh.
+
+    Factored out of ``SpmdDLRMTrainer`` so the billion-row feasibility path
+    (VERDICT r4 #3) can AOT-compile the REAL step from ShapeDtypeStructs —
+    a 2^30-row table is never materialized on a dev box, exactly like the
+    8B body in ``parallel/feasibility.py``.
+
+    Returns ``(jitted_step, shardings)`` where shardings carry the input
+    layout: table row-sharded over ``model`` (the reference's key-range
+    server partition), MLP replicated, batch over ``data``, unique slot
+    ids replicated.
+    """
+    t_shard = mesh_lib.table_sharding(mesh)
+    repl = mesh_lib.replicated(mesh)
+    batch2 = mesh_lib.batch_sharding(mesh, 2)
+    batch1 = mesh_lib.batch_sharding(mesh, 1)
+    state_keys = sorted(optimizer.state_shapes())
+    trash = table_cfg.rows  # trash row id (pads live past it)
+
+    def step_fn(
+        emb_value, emb_state, mlp_params, opt_state,
+        ids, inverse, dense_feats, labels,
+    ):
+        batch = labels.shape[0]
+        v_rows = scatter.gather_rows(emb_value, ids)
+        s_rows = {k: scatter.gather_rows(v, ids) for k, v in emb_state.items()}
+        w_rows = optimizer.pull_weights(v_rows, s_rows)
+
+        def loss_fn(mlp_p, rows):
+            emb = rows[inverse].reshape(batch, n_sparse, -1)
+            logits = model.apply({"params": mlp_p}, dense_feats, emb)
+            return logloss(logits, labels)
+
+        l, (g_mlp, g_rows) = jax.value_and_grad(loss_fn, argnums=(0, 1))(
+            mlp_params, w_rows
+        )
+        updates, opt_state = tx.update(g_mlp, opt_state, mlp_params)
+        mlp_params = optax.apply_updates(mlp_params, updates)
+        new_v, new_s = optimizer.apply(v_rows, s_rows, g_rows)
+        emb_value = scatter.scatter_update_rows_xla(emb_value, ids, new_v)
+        emb_state = {
+            k: scatter.scatter_update_rows_xla(emb_state[k], ids, new_s[k])
+            for k in emb_state
+        }
+        # trash-row reset (PAD gradients)
+        fills = optimizer.state_shapes()
+        emb_value = emb_value.at[trash].set(0.0)
+        emb_state = {k: emb_state[k].at[trash].set(fills[k]) for k in emb_state}
+        return emb_value, emb_state, mlp_params, opt_state, l
+
+    step = jax.jit(
+        step_fn,
+        in_shardings=(
+            t_shard,
+            {k: t_shard for k in state_keys},
+            repl,
+            repl,
+            repl,  # ids: replicated unique slots
+            repl,  # inverse
+            batch2,
+            batch1,
+        ),
+        out_shardings=(
+            t_shard,
+            {k: t_shard for k in state_keys},
+            repl,
+            repl,
+            repl,
+        ),
+        donate_argnums=(0, 1, 2, 3),
+    )
+    shardings = {
+        "table": t_shard, "replicated": repl,
+        "batch2": batch2, "batch1": batch1,
+    }
+    return step, shardings
+
+
+def init_sharded_table(
+    table_cfg: TableConfig,
+    mesh: Mesh,
+    optimizer: ServerOptimizer,
+    total_rows: int,
+    key=None,
+    kind: str = "normal",
+):
+    """Materialize (value, state) DIRECTLY into their row shards.
+
+    ``jit`` with ``out_shardings`` makes GSPMD generate each device's rows
+    in place (partitionable threefry), so peak per-device memory is the
+    shard, never the full table — the only way a near-HBM-sized table can
+    come up on real hardware, and what keeps the 2^28-row CPU-mesh proof
+    inside host RAM.
+
+    ``kind="zeros"`` skips the gaussian draw (memset-speed): cold-start
+    embeddings at tens of GB, where RNG generation dominates bring-up —
+    the row-sharded layout and the train step are identical either way.
+    """
+    if kind not in ("normal", "zeros"):
+        raise ValueError(f"kind must be normal|zeros, got {kind!r}")
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    t_shard = mesh_lib.table_sharding(mesh)
+    dim = table_cfg.dim
+    fills = optimizer.state_shapes()
+
+    @functools.partial(
+        jax.jit,
+        static_argnums=(1,),
+        out_shardings=(t_shard, {k: t_shard for k in sorted(fills)}),
+    )
+    def build(key, kind_):
+        if kind_ == "zeros":
+            value = jnp.zeros((total_rows, dim), jnp.float32)
+        else:
+            value = (
+                jax.random.normal(key, (total_rows, dim))
+                * table_cfg.init_scale
+            ).astype(jnp.float32)
+            value = value.at[table_cfg.rows :].set(0.0)  # trash + pad rows
+        state = {
+            k: jnp.full((total_rows, dim), fill, jnp.float32)
+            for k, fill in fills.items()
+        }
+        return value, state
+
+    with mesh:
+        return build(key, kind)
+
+
 class SpmdDLRMTrainer:
     """DLRM over a (data, model) mesh: PS-sharded embeddings + DP dense part."""
 
@@ -87,6 +225,7 @@ class SpmdDLRMTrainer:
         learning_rate: float = 0.01,
         min_bucket: int = 1024,
         seed: int = 0,
+        table_init: str = "normal",
         dashboard=None,
     ) -> None:
         from parameter_server_tpu.utils import metrics as metrics_lib
@@ -107,26 +246,15 @@ class SpmdDLRMTrainer:
         )
         self.tx = optax.adam(learning_rate)
 
-        t_shard = mesh_lib.table_sharding(mesh)
         repl = mesh_lib.replicated(mesh)
         n_model = mesh.shape[mesh_lib.MODEL_AXIS]
         self.total_rows = ((table_cfg.rows + 1 + n_model - 1) // n_model) * n_model
 
-        key = jax.random.PRNGKey(seed)
-        k_table, k_mlp = jax.random.split(key)
-        value = (
-            jax.random.normal(k_table, (self.total_rows, table_cfg.dim))
-            * table_cfg.init_scale
-        ).astype(jnp.float32)
-        value = value.at[table_cfg.rows :].set(0.0)  # trash + pad rows
-        self.emb_value = jax.device_put(value, t_shard)
-        self.emb_state = {
-            k: jax.device_put(
-                jnp.full((self.total_rows, table_cfg.dim), fill, jnp.float32),
-                t_shard,
-            )
-            for k, fill in self.optimizer.state_shapes().items()
-        }
+        k_table, k_mlp = jax.random.split(jax.random.PRNGKey(seed))
+        self.emb_value, self.emb_state = init_sharded_table(
+            table_cfg, mesh, self.optimizer, self.total_rows, key=k_table,
+            kind=table_init,
+        )
         dense0 = jnp.zeros((1, n_dense), jnp.float32)
         emb0 = jnp.zeros((1, n_sparse, table_cfg.dim), jnp.float32)
         self.mlp_params = jax.device_put(
@@ -134,63 +262,8 @@ class SpmdDLRMTrainer:
         )
         self.opt_state = jax.device_put(self.tx.init(self.mlp_params), repl)
 
-        batch2 = mesh_lib.batch_sharding(mesh, 2)
-        batch1 = mesh_lib.batch_sharding(mesh, 1)
-        model, optimizer, tx = self.model, self.optimizer, self.tx
-        n_sparse_ = n_sparse
-        self_trash = table_cfg.rows  # trash row id (pads live past it)
-
-        def step_fn(
-            emb_value, emb_state, mlp_params, opt_state,
-            ids, inverse, dense_feats, labels,
-        ):
-            batch = labels.shape[0]
-            v_rows = scatter.gather_rows(emb_value, ids)
-            s_rows = {k: scatter.gather_rows(v, ids) for k, v in emb_state.items()}
-            w_rows = optimizer.pull_weights(v_rows, s_rows)
-
-            def loss_fn(mlp_p, rows):
-                emb = rows[inverse].reshape(batch, n_sparse_, -1)
-                logits = model.apply({"params": mlp_p}, dense_feats, emb)
-                return logloss(logits, labels)
-
-            l, (g_mlp, g_rows) = jax.value_and_grad(loss_fn, argnums=(0, 1))(
-                mlp_params, w_rows
-            )
-            updates, opt_state = tx.update(g_mlp, opt_state, mlp_params)
-            mlp_params = optax.apply_updates(mlp_params, updates)
-            new_v, new_s = optimizer.apply(v_rows, s_rows, g_rows)
-            emb_value = scatter.scatter_update_rows_xla(emb_value, ids, new_v)
-            emb_state = {
-                k: scatter.scatter_update_rows_xla(emb_state[k], ids, new_s[k])
-                for k in emb_state
-            }
-            # trash-row reset (PAD gradients)
-            fills = optimizer.state_shapes()
-            emb_value = emb_value.at[self_trash].set(0.0)
-            emb_state = {k: emb_state[k].at[self_trash].set(fills[k]) for k in emb_state}
-            return emb_value, emb_state, mlp_params, opt_state, l
-
-        self._step = jax.jit(
-            step_fn,
-            in_shardings=(
-                t_shard,
-                {k: t_shard for k in self.emb_state},
-                repl,
-                repl,
-                repl,  # ids: replicated unique slots
-                repl,  # inverse
-                batch2,
-                batch1,
-            ),
-            out_shardings=(
-                t_shard,
-                {k: t_shard for k in self.emb_state},
-                repl,
-                repl,
-                repl,
-            ),
-            donate_argnums=(0, 1, 2, 3),
+        self._step, _shardings = make_dlrm_step(
+            table_cfg, mesh, self.model, self.optimizer, self.tx, n_sparse,
         )
 
     def step(

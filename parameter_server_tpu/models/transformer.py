@@ -55,14 +55,20 @@ class TransformerConfig:
     #: — measured on the 8B feasibility path, unrolled remat saves ~nothing
     #: while scan+remat cuts temp memory several-fold.
     scan_blocks: bool = False
-    #: attention implementation: "dense" (full scores matrix) or "ring"
+    #: attention implementation: "dense" (full scores matrix), "ring"
     #: (sequence-parallel exact attention via ppermute over the ``sp_axis``
     #: mesh axis — ONLY valid inside a shard_map that carries that axis;
-    #: ``parallel/sp_lm.py`` is the trainer that sets this up).  The param
-    #: tree is identical either way, so dense-initialized checkpoints load
-    #: into ring models and vice versa.
+    #: ``parallel/sp_lm.py`` is the trainer that sets this up), "ulysses"
+    #: (same contract as "ring"), or "ring_spmd" (the ring wrapped in a
+    #: PARTIAL shard_map — callable from ordinary GSPMD code on global
+    #: views, composing with TP/FSDP shardings on the other mesh axes;
+    #: requires ``spmd_mesh``; ``parallel/sp_fsdp.py`` is the trainer).
+    #: The param tree is identical in every case, so dense-initialized
+    #: checkpoints load into ring models and vice versa.
     attn_impl: str = "dense"
     sp_axis: str = "sp"
+    #: concrete mesh for "ring_spmd" (the partial shard_map must name it)
+    spmd_mesh: Any = None
 
     @property
     def head_dim(self) -> int:
@@ -152,13 +158,27 @@ class Attention(nn.Module):
             rep = H // KV
             k = jnp.repeat(k, rep, axis=2)
             v = jnp.repeat(v, rep, axis=2)
-        if cfg.attn_impl in ("ring", "ulysses"):
+        if cfg.attn_impl in ("ring", "ulysses", "ring_spmd"):
             if attn_mask is not None:
                 raise ValueError(
                     "sequence-parallel attention does not support attn_mask "
                     "(padding masks are a dense-impl feature)"
                 )
-            if cfg.attn_impl == "ring":
+            if cfg.attn_impl == "ring_spmd":
+                from parameter_server_tpu.ops.ring_attention import (
+                    ring_attention_spmd,
+                )
+
+                if cfg.spmd_mesh is None:
+                    raise ValueError(
+                        "attn_impl='ring_spmd' needs cfg.spmd_mesh (the "
+                        "partial shard_map must name a concrete mesh)"
+                    )
+                out = ring_attention_spmd(
+                    q, k, v, mesh=cfg.spmd_mesh, sp_axis=cfg.sp_axis,
+                    causal=cfg.causal,
+                ).astype(cfg.dtype)
+            elif cfg.attn_impl == "ring":
                 from parameter_server_tpu.ops.ring_attention import (
                     ring_attention,
                 )

@@ -1,0 +1,273 @@
+"""Sequence parallelism COMPOSED with TP + FSDP-state in one GSPMD program.
+
+``SpLMTrainer`` (parallel/sp_lm.py) proves ring-attention AD with params
+replicated per device — fine for the mechanism, impossible for an 8B model
+on 16 GB chips (fp32 params alone are 32 GB).  This module is the at-scale
+composition VERDICT r4 #5 asked for: one jit-compiled train step where
+
+- the SEQUENCE is sharded over the ``sp`` mesh axis (ring attention —
+  exact, O(S/n) activations per device),
+- the WEIGHTS are tensor-parallel over the ``model`` axis (Megatron-style
+  column/row pairing via ``parallel/tp.py``'s GSPMD rules),
+- the OPTIMIZER MOMENTS are additionally sharded over ``sp``
+  (``fsdp="state"`` — the knob whose saving survives the layer scan, same
+  as the dense 8B recipe), and
+- ``cfg.scan_blocks`` + ``cfg.remat`` + a per-shard chunked fused-head
+  loss bound activation memory.
+
+The architectural trick is ``ops.ring_attention_spmd``: ring attention in
+a PARTIAL ``jax.shard_map`` (``axis_names={'sp'}``) — only the ring's axis
+goes manual, so the flax trunk stays an ordinary GSPMD program and the TP
+shardings on every matmul keep flowing through XLA untouched.  Contrast
+``SpLMTrainer``, which wraps the WHOLE trunk in a shard_map and therefore
+cannot express per-weight partitioning without manual collectives.
+
+The loss runs in a second partial shard_map: each device computes its
+local sequence chunk's fused-head NLL (rematerialized chunks, vocab dim
+still free for the ``model`` axis) and a single ``psum`` over ``sp``
+produces the global mean — same shift semantics as ``causal_lm_loss``.
+
+Reference analogue: the long-context/sequence-parallel training the
+reference's NCCL/MPI backend composes with its tensor parallelism
+(SURVEY.md §5 long-context row [U]); here the composition is one XLA
+program over a (sp, model) mesh with ICI collectives.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from parameter_server_tpu.models import transformer as tfm
+from parameter_server_tpu.utils import metrics as metrics_lib
+
+SP_AXIS = "sp"
+MODEL_AXIS = "model"
+
+
+def sp_chunked_causal_loss(
+    hidden: jax.Array,
+    head_kernel: jax.Array,
+    targets: jax.Array,
+    mask: jax.Array,
+    *,
+    mesh: Mesh,
+    chunk: int,
+) -> jax.Array:
+    """Fused-head causal NLL on a sequence sharded over ``sp``.
+
+    ``chunked_causal_lm_loss`` slices the GLOBAL sequence axis, which under
+    an ``sp`` sharding would make every chunk a cross-device reshard; here
+    each device chunks its LOCAL shard instead (partial shard_map, manual
+    only over ``sp``), keeps one rematerialized ``[B, chunk, V]`` slab live
+    at a time — the vocab dim stays free for the ``model`` TP sharding —
+    and a ``psum`` over ``sp`` delivers the global masked mean.
+
+    ``targets``/``mask`` carry the caller's shift convention (targets[t] =
+    tokens[t+1], mask kills the last global position), so the result equals
+    ``causal_lm_loss(hidden @ head_kernel, tokens)`` up to summation order.
+    """
+
+    def local(h_l, t_l, m_l, w):
+        B, s_local, _d = h_l.shape
+        c = min(chunk, s_local)
+        pad = (-s_local) % c
+        if pad:
+            h_l = jnp.pad(h_l, ((0, 0), (0, pad), (0, 0)))
+            t_l = jnp.pad(t_l, ((0, 0), (0, pad)))
+            m_l = jnp.pad(m_l, ((0, 0), (0, pad)))
+        n_chunks = (s_local + pad) // c
+        xs = h_l.reshape(B, n_chunks, c, -1).transpose(1, 0, 2, 3)
+        tg = t_l.reshape(B, n_chunks, c).transpose(1, 0, 2)
+        mk = m_l.reshape(B, n_chunks, c).transpose(1, 0, 2)
+
+        @jax.checkpoint
+        def chunk_nll(xc, tc, mc):
+            logits = jnp.einsum(
+                "bcd,dv->bcv", xc, w, preferred_element_type=jnp.float32
+            )
+            logp = jax.nn.log_softmax(logits)
+            nll = -jnp.take_along_axis(logp, tc[..., None], axis=-1)[..., 0]
+            return jnp.sum(nll * mc)
+
+        def body(acc, args):
+            return acc + chunk_nll(*args), None
+
+        # carry starts device-varying over sp (each shard accumulates its
+        # own NLL): mark it so, or the scan rejects the carry type (VMA)
+        acc0 = jax.lax.pcast(jnp.float32(0.0), (SP_AXIS,), to="varying")
+        total, _ = jax.lax.scan(body, acc0, (xs, tg, mk))
+        loss_sum = jax.lax.psum(total, SP_AXIS)
+        count = jax.lax.psum(jnp.sum(m_l), SP_AXIS)
+        return loss_sum / jnp.maximum(count, 1.0)
+
+    seq3 = P(None, SP_AXIS, None)
+    seq2 = P(None, SP_AXIS)
+    return jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(seq3, seq2, seq2, P()),
+        out_specs=P(),
+        axis_names=frozenset({SP_AXIS}),
+    )(hidden, targets, mask, head_kernel)
+
+
+def make_sp_step(cfg_run: tfm.TransformerConfig, mesh: Mesh, tx, chunk: int):
+    """Build the jitted composed train step (no params materialized).
+
+    ``cfg_run`` must already carry ``attn_impl="ring_spmd"`` + the mesh;
+    shardings ride on the input arrays (or ShapeDtypeStructs — the 8B
+    feasibility path compiles this exact step from shapes alone, the same
+    AOT technique as ``feasibility.compile_body_step``).
+    """
+    import optax
+
+    trunk = tfm.TransformerTrunk(cfg_run)
+
+    def loss_fn(params, tokens, targets, mask):
+        x = jnp.take(params["embedding"], tokens, axis=0)
+        trunk_params = {
+            k: v
+            for k, v in params.items()
+            if k not in ("embedding", "lm_head")
+        }
+        hidden = trunk.apply({"params": trunk_params}, x)
+        return sp_chunked_causal_loss(
+            hidden, params["lm_head"]["kernel"], targets, mask,
+            mesh=mesh, chunk=chunk,
+        )
+
+    def step_fn(params, opt_state, tokens, targets, mask):
+        loss, grads = jax.value_and_grad(loss_fn)(
+            params, tokens, targets, mask
+        )
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return jax.jit(step_fn, donate_argnums=(0, 1)), jax.jit(loss_fn)
+
+
+class SpTpLMTrainer:
+    """Causal LM: sequence over ``sp`` x weights over ``model`` x
+    moments-FSDP over ``sp`` — the composed long-context trainer."""
+
+    def __init__(
+        self,
+        cfg: tfm.TransformerConfig,
+        mesh: Mesh,
+        *,
+        learning_rate: float = 1e-3,
+        seed: int = 0,
+        fsdp: str = "state",
+        loss_chunk: int = 512,
+        dashboard: Optional[metrics_lib.Dashboard] = None,
+    ) -> None:
+        import optax
+
+        from parameter_server_tpu.parallel.tp import (
+            transformer_param_shardings,
+        )
+
+        for axis in (SP_AXIS, MODEL_AXIS):
+            if axis not in mesh.axis_names:
+                raise ValueError(
+                    f"mesh must carry a {axis!r} axis, got {mesh.axis_names}"
+                )
+        if not cfg.causal:
+            raise ValueError("SpTpLMTrainer is a causal-LM trainer")
+        if cfg.tie_embeddings:
+            raise ValueError(
+                "SpTpLMTrainer needs untied embeddings (fused head loss)"
+            )
+        if fsdp not in ("none", "state"):
+            raise ValueError(f"fsdp must be none|state, got {fsdp!r}")
+        self.mesh = mesh
+        self.n_shards = mesh.shape[SP_AXIS]
+        #: runtime twin: ring attention via the partial shard_map
+        self.cfg = dataclasses.replace(
+            cfg, attn_impl="ring_spmd", sp_axis=SP_AXIS, spmd_mesh=mesh
+        )
+        cfg_dense = dataclasses.replace(cfg, attn_impl="dense")
+        self.tx = optax.adamw(learning_rate)
+        self.loss_chunk = int(loss_chunk)
+
+        # init with the dense twin (identical param tree), then place per
+        # the TP rules; moments optionally further sharded over sp
+        model_init = tfm.Transformer(cfg_dense)
+        tokens0 = jnp.zeros((1, 8), jnp.int32)
+        params = model_init.init(jax.random.PRNGKey(seed), tokens0)["params"]
+        p_shard = transformer_param_shardings(params, mesh)
+        self.params = jax.tree.map(jax.device_put, params, p_shard)
+        opt_state = self.tx.init(self.params)  # inherits param shardings
+        if fsdp == "state":
+            import optax as _optax
+
+            s_shard = transformer_param_shardings(
+                params, mesh, fsdp=True, fsdp_axis=SP_AXIS
+            )
+            opt_state = _optax.tree_map_params(
+                self.tx,
+                lambda leaf, sh: jax.device_put(leaf, sh),
+                opt_state,
+                s_shard,
+            )
+        self.opt_state = opt_state
+
+        self._step, self._loss = make_sp_step(
+            self.cfg, mesh, self.tx, self.loss_chunk
+        )
+        self._seq_sharding = NamedSharding(mesh, P(None, SP_AXIS))
+
+        self.dashboard = metrics_lib.trainer_dashboard(
+            dashboard, mesh.devices.size
+        )
+        self.n_matmul_params = metrics_lib.lm_matmul_params(
+            self.params, frozenset({"pos_embedding", "embedding"})
+        )
+        self.step_count = 0
+
+    def _place(self, tokens: np.ndarray):
+        """Next-token shift + mask, seq-sharded over ``sp`` (GLOBAL views:
+        GSPMD owns the distribution, unlike SpLMTrainer's local shards)."""
+        tokens = np.asarray(tokens, np.int32)
+        B, S = tokens.shape
+        if S % self.n_shards:
+            raise ValueError(f"seq {S} % sp shards {self.n_shards} != 0")
+        if self.cfg.positional == "learned" and S > self.cfg.max_seq:
+            raise ValueError(
+                f"sequence {S} exceeds learned-positional max_seq "
+                f"{self.cfg.max_seq}"
+            )
+        targets = np.concatenate(
+            [tokens[:, 1:], np.zeros((B, 1), np.int32)], axis=1
+        )
+        mask = np.broadcast_to(
+            (np.arange(S) < S - 1).astype(np.float32), (B, S)
+        )
+        put = lambda a: jax.device_put(a, self._seq_sharding)  # noqa: E731
+        return put(tokens), put(targets), put(np.ascontiguousarray(mask))
+
+    def step(self, tokens: np.ndarray) -> float:
+        tok, tgt, msk = self._place(tokens)
+        self.params, self.opt_state, loss = self._step(
+            self.params, self.opt_state, tok, tgt, msk
+        )
+        loss_f = float(loss)
+        self.step_count += 1
+        self.dashboard.flops_per_example = (
+            6.0 * self.n_matmul_params * tokens.shape[1]
+        )
+        self.dashboard.record(
+            self.step_count, loss_f, examples=int(tokens.shape[0])
+        )
+        return loss_f
+
+    def loss(self, tokens: np.ndarray) -> float:
+        tok, tgt, msk = self._place(tokens)
+        return float(self._loss(self.params, tok, tgt, msk))

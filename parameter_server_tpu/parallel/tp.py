@@ -54,7 +54,7 @@ def _spec_for(path: tuple[str, ...], value: Any) -> P:
     return P()  # norms and everything else replicated
 
 
-def _add_fsdp_axis(spec: P, shape, data_n: int) -> P:
+def _add_fsdp_axis(spec: P, shape, data_n: int, axis: str) -> P:
     """Extend a TP spec with ``data``-axis sharding on the first free dim.
 
     Fully-sharded data parallelism in GSPMD terms: params (and therefore
@@ -68,18 +68,21 @@ def _add_fsdp_axis(spec: P, shape, data_n: int) -> P:
     parts = list(spec) + [None] * (len(shape) - len(spec))
     for i, (p, s) in enumerate(zip(parts, shape)):
         if p is None and s % data_n == 0 and s >= data_n:
-            parts[i] = DATA_AXIS
+            parts[i] = axis
             break
     return P(*parts)
 
 
-def transformer_param_shardings(params, mesh: Mesh, *, fsdp: bool = False):
+def transformer_param_shardings(
+    params, mesh: Mesh, *, fsdp: bool = False, fsdp_axis: str = DATA_AXIS
+):
     """Map a transformer param pytree to NamedShardings per the TP rules.
 
     ``fsdp=True`` additionally shards every param's first still-replicated
-    (and evenly divisible) dimension over the ``data`` axis.
+    (and evenly divisible) dimension over ``fsdp_axis`` (default ``data``;
+    the SP x TP trainer passes ``sp`` — any non-``model`` axis works).
     """
-    data_n = int(mesh.shape.get(DATA_AXIS, 1)) if fsdp else 1
+    data_n = int(mesh.shape.get(fsdp_axis, 1)) if fsdp else 1
 
     def assign(path, value):
         names = tuple(
@@ -95,7 +98,7 @@ def transformer_param_shardings(params, mesh: Mesh, *, fsdp: bool = False):
         else:
             spec = _spec_for(names, value)
         if data_n > 1:
-            spec = _add_fsdp_axis(spec, value.shape, data_n)
+            spec = _add_fsdp_axis(spec, value.shape, data_n, fsdp_axis)
         return NamedSharding(mesh, spec)
 
     return jax.tree_util.tree_map_with_path(assign, params)

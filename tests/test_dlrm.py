@@ -148,3 +148,46 @@ def test_tail_filter_never_drops_frequent_keys():
     (out,) = stream2()
     assert (out[:, -1] == PAD_KEY).all()
     assert stream2.seen == 4 * 7
+
+
+def test_dlrm_feasibility_aot_never_materializes():
+    """The billion-row AOT path (VERDICT r4 #3) at test scale: compile the
+    REAL step from ShapeDtypeStructs on the 8-dev mesh and read XLA's
+    per-device memory — table bytes must dominate and fit the budget."""
+    from parameter_server_tpu.parallel.feasibility import dlrm_feasibility
+
+    out = dlrm_feasibility(
+        rows_log2=18, dim=16, mesh_shape=(1, 8), batch=256, slots_log2=10
+    )
+    assert out["fits_v5e"] is True
+    # value + adagrad state, row-sharded 8 ways
+    assert out["table_bytes_per_device"] == 2 * ((1 << 18) + 8) * 16 * 4 // 8
+    assert out["peak_bytes"] >= out["table_bytes_per_device"]
+    # temps are O(batch), not O(table): far below one table shard
+    assert out["temp_bytes"] < out["table_bytes_per_device"]
+
+
+def test_init_sharded_table_zeros_matches_layout():
+    """kind="zeros" must produce the same sharded layout/state fills as the
+    gaussian init (only the value distribution differs)."""
+    import jax
+
+    from parameter_server_tpu.kv.optim import make_optimizer
+    from parameter_server_tpu.models.dlrm import init_sharded_table
+
+    cfg = TableConfig(
+        name="emb", rows=1 << 10, dim=8, init_scale=0.01,
+        optimizer=OptimizerConfig(kind="adagrad", learning_rate=0.05),
+    )
+    mesh = mesh_lib.make_mesh((1, 8))
+    opt = make_optimizer(cfg.optimizer)
+    total = ((cfg.rows + 1 + 7) // 8) * 8
+    vz, sz = init_sharded_table(cfg, mesh, opt, total, kind="zeros")
+    vn, sn = init_sharded_table(cfg, mesh, opt, total, kind="normal")
+    assert vz.sharding == vn.sharding and vz.shape == vn.shape
+    assert float(jax.numpy.abs(vz).max()) == 0.0
+    # the gaussian twin really drew values (nonzero init_scale): the kind
+    # dispatch is observable, only the distribution differs
+    assert float(jax.numpy.abs(vn[: cfg.rows]).max()) > 0.0
+    for k in sz:
+        np.testing.assert_array_equal(np.asarray(sz[k]), np.asarray(sn[k]))

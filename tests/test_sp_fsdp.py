@@ -1,0 +1,108 @@
+"""SP x TP x FSDP-state composition (parallel/sp_fsdp.py, VERDICT r4 #5).
+
+The composed long-context trainer must compute the SAME function as the
+dense single-device trainer while actually sharding: sequence over ``sp``
+(ring attention via partial shard_map), weights over ``model`` (TP rules),
+moments over ``sp`` (FSDP-state).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh
+
+from parameter_server_tpu.learner.lm import SpmdLMTrainer
+from parameter_server_tpu.models import transformer as tfm
+from parameter_server_tpu.parallel import mesh as mesh_lib
+from parameter_server_tpu.parallel.sp_fsdp import SpTpLMTrainer
+
+
+def _mesh(sp=4, tp=2):
+    return Mesh(np.asarray(jax.devices()).reshape(sp, tp), ("sp", "model"))
+
+
+def _cfg(**kw):
+    defaults = dict(
+        causal=True, tie_embeddings=False, n_heads=4, n_kv_heads=2,
+        max_seq=256,
+    )
+    defaults.update(kw)
+    return tfm.tiny_config(**defaults)
+
+
+def test_sptp_matches_dense_trainer_trajectory():
+    """Same seed, same stream: the (sp=4, model=2) composed trajectory
+    equals the dense single-device trainer's — ring + TP + moments-FSDP +
+    chunked loss change the distribution, not the math."""
+    cfg = _cfg()
+    tr = SpTpLMTrainer(cfg, _mesh(), fsdp="state", loss_chunk=16, seed=0)
+    ref = SpmdLMTrainer(
+        cfg, mesh_lib.make_mesh((1, 1), devices=jax.devices()[:1]), seed=0
+    )
+    rng = np.random.default_rng(0)
+    toks = [
+        rng.integers(0, cfg.vocab_size, size=(2, 64)).astype(np.int32)
+        for _ in range(4)
+    ]
+    l_sp = [tr.step(t) for t in toks]
+    l_ref = [ref.step_causal(t) for t in toks]
+    np.testing.assert_allclose(l_sp, l_ref, rtol=2e-4, atol=2e-5)
+
+
+def test_sptp_composes_with_scan_remat():
+    """scan_blocks + remat + the composed shardings in one step."""
+    cfg = _cfg(scan_blocks=True, remat=True)
+    tr = SpTpLMTrainer(cfg, _mesh(), fsdp="state", loss_chunk=16, seed=1)
+    rng = np.random.default_rng(1)
+    losses = [
+        tr.step(
+            rng.integers(0, cfg.vocab_size, size=(2, 64)).astype(np.int32)
+        )
+        for _ in range(3)
+    ]
+    assert np.all(np.isfinite(losses))
+    assert losses[-1] < losses[0] + 0.5  # trains, not diverges
+
+
+def test_sptp_shardings_are_real():
+    """Weights carry the model axis, moments additionally carry sp, and
+    no param is fully replicated when the TP rule shards it."""
+    cfg = _cfg()
+    mesh = _mesh()
+    tr = SpTpLMTrainer(cfg, mesh, fsdp="state", loss_chunk=16)
+
+    def spec_names(arr):
+        out = set()
+        for axes in arr.sharding.spec:
+            if axes is None:
+                continue
+            for a in (axes if isinstance(axes, tuple) else (axes,)):
+                out.add(a)
+        return out
+
+    # attention q kernel: TP over heads
+    q_kernel = tr.params["layer_0"]["attn"]["q"]["kernel"]
+    assert "model" in spec_names(q_kernel)
+    # its adamw moment: TP AND sp (FSDP-state)
+    import optax
+
+    mu = None
+    for leaf_state in tr.opt_state:
+        if isinstance(leaf_state, optax.ScaleByAdamState):
+            mu = leaf_state.mu["layer_0"]["attn"]["q"]["kernel"]
+            break
+    assert mu is not None
+    assert {"model", "sp"} <= spec_names(mu)
+
+
+def test_sptp_rejects_bad_configs():
+    with pytest.raises(ValueError, match="sp"):
+        SpTpLMTrainer(_cfg(), mesh_lib.make_mesh((4, 2)))  # data/model mesh
+    with pytest.raises(ValueError, match="causal"):
+        SpTpLMTrainer(
+            tfm.tiny_config(causal=False, tie_embeddings=False), _mesh()
+        )
+    tr = SpTpLMTrainer(_cfg(), _mesh())
+    with pytest.raises(ValueError, match="sp shards"):
+        tr.step(np.zeros((2, 30), np.int32))  # 30 % 4 != 0
