@@ -111,6 +111,198 @@ def pipeline_apply(
     return out_buf
 
 
+def pipeline_1f1b(
+    stage_fn: Callable,
+    loss_fn: Callable,
+    stage_params,
+    tail_params,
+    x_micro: jax.Array,
+    tgt_micro: jax.Array,
+    *,
+    axis_name: str = PP_AXIS,
+):
+    """1F1B-interleaved pipeline with MANUAL backward.  Call inside shard_map.
+
+    GPipe-through-AD (``pipeline_apply``) saves one residual per tick for
+    the whole scan — O(M) stage inputs live on every device while the
+    backward drains.  1F1B interleaves: each microbatch's backward runs as
+    soon as the last stage finishes its forward, so a stage only ever
+    holds the activations of the microbatches in flight — O(S), M-
+    independent.  That is the schedule's entire point; FLOPs are identical
+    (one fwd + one recompute + one bwd per microbatch per stage).
+
+    SPMD-uniform retiming: per scan iteration ``i`` every stage ``s`` runs
+    exactly ONE forward (microbatch ``i - s``) and ONE backward
+    (microbatch ``i - (2(S-1) - s)``), both masked outside their range —
+    the last stage's backward consumes its SAME-iteration forward, seeded
+    by ``loss_fn``'s vjp, and gradients ride the reverse ring one hop per
+    iteration.  ``loss_fn(tail_params, y, tgt) -> scalar`` is computed on
+    every stage and masked (SPMD uniformity): the head matmul costs S x
+    its share of FLOPs — cheap next to the body whenever head << stages,
+    the regime pipeline parallelism exists for.  Activation stash:
+    ``[2S, ...]`` ring-indexed by microbatch (in-flight <= 2S-1).
+
+    Returns ``(loss_mean, dstage_params, dtail_params, dx_micro)``; the
+    caller owns the embedding backward (vjp of its own lookup with
+    ``dx_micro``) and the optimizer step.  ``dtail_params`` is already
+    psum'd over ``axis_name``; ``dstage_params`` is each device's own
+    stage gradient; ``dx_micro`` is sharded by microbatch owner like
+    ``x_micro``.
+    """
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    m_local = x_micro.shape[0]
+    n_micro = m_local * n
+    perm_f = [(i, (i + 1) % n) for i in range(n)]
+    perm_b = [(i, (i - 1) % n) for i in range(n)]
+    stash_slots = 2 * n
+    #: the full varying-manual-axes set of this shard_map context (e.g.
+    #: {'data', 'pp'} under DP x PP) — fresh invariant values that will
+    #: accumulate varying data must be cast to ALL of it, not just pp
+    ctx_vma = tuple(sorted(x_micro.aval.vma))
+
+    def zeros_of(tree):
+        return jax.tree.map(jnp.zeros_like, tree)
+
+    recv_f0 = jnp.zeros_like(x_micro[0])
+    recv_b0 = jnp.zeros_like(x_micro[0])
+    stash0 = jnp.zeros((stash_slots,) + x_micro.shape[1:], x_micro.dtype)
+    dx0 = jnp.zeros_like(x_micro)
+
+    def tick(carry, i):
+        recv_f, recv_b, stash, loss_sum, dstage, dtail, dx_buf = carry
+
+        # ---- forward of microbatch m_f = i - idx -------------------------
+        # NB: every psum DELIVERY below must key on a device-UNIFORM
+        # microbatch index (the consuming stage's), or the sum mixes each
+        # device's own notion of "its" microbatch into garbage.
+        m_f = i - idx
+        active_f = jnp.logical_and(m_f >= 0, m_f < n_micro)
+        mb_f = jnp.clip(m_f, 0, n_micro - 1)
+        # stage 0 injects microbatch i (its own fwd): uniform index
+        mb_inj = jnp.clip(i, 0, n_micro - 1)
+        slot_inj = jnp.clip(mb_inj % m_local, 0, m_local - 1)
+        mine = jax.lax.dynamic_index_in_dim(
+            x_micro, slot_inj, keepdims=False
+        )
+        inject = jax.lax.psum(
+            jnp.where(idx == mb_inj // m_local, mine, jnp.zeros_like(mine)),
+            axis_name,
+        )
+        x_in = jnp.where(idx == 0, inject, recv_f)
+        x_in = jnp.where(active_f, x_in, jnp.zeros_like(x_in))
+        y = stage_fn(stage_params, x_in)
+        # stash the stage INPUT for the recompute-backward, ring-indexed
+        st_slot = mb_f % stash_slots
+        cur = jax.lax.dynamic_index_in_dim(stash, st_slot, keepdims=False)
+        stash = jax.lax.dynamic_update_index_in_dim(
+            stash, jnp.where(active_f, x_in, cur), st_slot, 0
+        )
+
+        # ---- backward of microbatch m_b = i - (2(S-1) - idx) -------------
+        m_b = i - (2 * (n - 1) - idx)
+        active_b = jnp.logical_and(m_b >= 0, m_b < n_micro)
+        mb_b = jnp.clip(m_b, 0, n_micro - 1)
+        is_last = idx == n - 1
+        # the last stage's backward is the SAME iteration as its forward:
+        # m_b == m_f there, so y is this iteration's; its target index
+        # i - (S-1) is the uniform delivery key
+        mb_tgt = jnp.clip(i - (n - 1), 0, n_micro - 1)
+        tslot_tgt = jnp.clip(mb_tgt % m_local, 0, m_local - 1)
+        tmine = jax.lax.dynamic_index_in_dim(
+            tgt_micro, tslot_tgt, keepdims=False
+        )
+        tgt = jax.lax.psum(
+            jnp.where(idx == mb_tgt // m_local, tmine, jnp.zeros_like(tmine)),
+            axis_name,
+        )
+        # vjp wrt a device-INVARIANT input auto-psums the partial across
+        # the mesh axis (the shard_map transpose rule) — which would mix
+        # every stage's masked-tick garbage into dtail_i BEFORE our gate.
+        # Cast the tail params varying so the partial stays per-device;
+        # the single explicit psum after the scan does the reduction.
+        tail_v = jax.tree.map(
+            lambda a: jax.lax.pcast(a, ctx_vma, to="varying"), tail_params
+        )
+        loss_m, vjp_tail = jax.vjp(
+            lambda tp, yy: loss_fn(tp, yy, tgt), tail_v, y
+        )
+        one = jax.lax.pcast(jnp.float32(1.0), ctx_vma, to="varying")
+        dtail_i, dy_last = vjp_tail(one)
+        dy = jnp.where(is_last, dy_last, recv_b)
+        xb_slot = mb_b % stash_slots
+        x_b = jax.lax.dynamic_index_in_dim(stash, xb_slot, keepdims=False)
+        _, vjp_stage = jax.vjp(stage_fn, stage_params, x_b)
+        dp_i, dx_i = vjp_stage(dy)
+
+        # select, don't multiply: 0 * inf/NaN from a masked tick's garbage
+        # inputs would still poison the accumulators
+        last_b = jnp.logical_and(active_b, is_last)
+        dstage = jax.tree.map(
+            lambda a, g: a + jnp.where(active_b, g, jnp.zeros_like(g)),
+            dstage, dp_i,
+        )
+        dtail = jax.tree.map(
+            lambda a, g: a + jnp.where(last_b, g, jnp.zeros_like(g)),
+            dtail, dtail_i,
+        )
+        loss_sum = loss_sum + jnp.where(last_b, loss_m, 0.0)
+
+        # stage 0 finished microbatch i - 2(S-1): ship d(embedding input)
+        # home (uniform delivery key again)
+        m_dx = i - 2 * (n - 1)
+        dx_valid = jnp.logical_and(m_dx >= 0, m_dx < n_micro)
+        mb_dx = jnp.clip(m_dx, 0, n_micro - 1)
+        done_dx = jax.lax.psum(
+            jnp.where(
+                jnp.logical_and(idx == 0, active_b),
+                dx_i,
+                jnp.zeros_like(dx_i),
+            ),
+            axis_name,
+        )
+        own_dx = jnp.logical_and(idx == mb_dx // m_local, dx_valid)
+        dslot = jnp.clip(mb_dx % m_local, 0, m_local - 1)
+        cur_dx = jax.lax.dynamic_index_in_dim(dx_buf, dslot, keepdims=False)
+        dx_buf = jax.lax.dynamic_update_index_in_dim(
+            dx_buf, jnp.where(own_dx, done_dx, cur_dx), dslot, 0
+        )
+
+        # rings: activations forward, gradients backward (zeros if masked)
+        recv_f = jax.lax.ppermute(
+            jnp.where(active_f, y, jnp.zeros_like(y)), axis_name, perm_f
+        )
+        recv_b = jax.lax.ppermute(
+            jnp.where(active_b, dx_i, jnp.zeros_like(dx_i)),
+            axis_name,
+            perm_b,
+        )
+        return (recv_f, recv_b, stash, loss_sum, dstage, dtail, dx_buf), None
+
+    n_iters = n_micro + 2 * (n - 1)
+    # carries that start device-invariant but accumulate device-varying
+    # values must be marked varying up front (shard_map VMA typing)
+    vary = lambda t: jax.tree.map(  # noqa: E731
+        lambda a: jax.lax.pcast(a, ctx_vma, to="varying"), t
+    )
+    carry0 = (
+        recv_f0, recv_b0, vary(stash0), vary(jnp.float32(0.0)),
+        zeros_of(stage_params), vary(zeros_of(tail_params)), dx0,
+    )
+    (_, _, _, loss_sum, dstage, dtail, dx_buf), _ = jax.lax.scan(
+        tick, carry0, jnp.arange(n_iters)
+    )
+    # loss lives on the last stage only; every stage accumulated its own
+    # dstage; dtail is last-stage-only -> share both
+    loss_mean = jax.lax.psum(loss_sum, axis_name) / n_micro
+    dtail = jax.tree.map(lambda g: jax.lax.psum(g, axis_name), dtail)
+    # match the pmean-loss convention of the GPipe path: grads of the MEAN
+    dstage = jax.tree.map(lambda g: g / n_micro, dstage)
+    dtail = jax.tree.map(lambda g: g / n_micro, dtail)
+    dx_buf = dx_buf / n_micro
+    return loss_mean, dstage, dtail, dx_buf
+
+
 def stack_stage_params(per_stage_params) -> object:
     """Stack a list of per-stage pytrees along a new leading stage axis."""
     return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage_params)
@@ -122,6 +314,151 @@ def stage_sharding(mesh: Mesh, tree) -> object:
         return NamedSharding(mesh, P(PP_AXIS, *(None,) * (leaf.ndim - 1)))
 
     return jax.tree.map(spec, tree)
+
+
+def make_pp_step(
+    cfg, mesh: Mesh, *, learning_rate: float = 1e-3, schedule: str = "gpipe"
+):
+    """Build the jitted PP train step WITHOUT materializing any params.
+
+    Factored from ``PipelinedLMTrainer`` so the PP-vs-DP feasibility
+    comparison (VERDICT r4 #9) can AOT-compile the real pipelined step
+    from ShapeDtypeStructs: params = {stages (stacked, pp-sharded), embed,
+    head, norm}; inputs = tokens_micro [n_micro, mb, seq] int32.
+
+    ``schedule``: "gpipe" (AD through the scanned pipeline; O(M) saved
+    residuals per device) or "1f1b" (``pipeline_1f1b``'s manual interleaved
+    backward; O(S) stash — same math, same FLOPs, M-independent memory).
+
+    Returns ``(step_fn_jitted, loss_fn_jitted, stage_module, norm_module,
+    tx)``; shardings ride on the inputs.
+    """
+    import optax
+
+    from parameter_server_tpu.models import transformer as tfm
+    from parameter_server_tpu.parallel.mesh import DATA_AXIS
+
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"schedule must be gpipe|1f1b, got {schedule!r}")
+    n_stages = mesh.shape[PP_AXIS]
+    if cfg.n_layers % n_stages:
+        raise ValueError(f"n_layers {cfg.n_layers} % pp {n_stages} != 0")
+    per_stage = cfg.n_layers // n_stages
+
+    class Stage(tfm.nn.Module):  # type: ignore[name-defined]
+        @tfm.nn.compact
+        def __call__(self, x):
+            positions = jnp.arange(x.shape[1])[None, :]
+            for _ in range(per_stage):
+                x = tfm.Block(cfg)(x, positions)
+            return x
+
+    stage_module = Stage()
+    norm_module = tfm.Norm(cfg.norm)
+    tx = optax.adamw(learning_rate)
+    data_axis = DATA_AXIS if DATA_AXIS in mesh.axis_names else None
+    axis = PP_AXIS
+
+    def stage_fn(stage_params_local, x):
+        local = jax.tree.map(lambda a: a[0], stage_params_local)
+        return stage_module.apply({"params": local}, x)
+
+    def loss_from(params, tokens_micro):
+        x = jnp.take(params["embed"], tokens_micro, axis=0)
+
+        def body(stages, x_micro, tokens_ref):
+            out = pipeline_apply(stage_fn, stages, x_micro, axis_name=axis)
+            out = norm_module.apply({"params": params["norm"]}, out)
+            logits = jnp.einsum("mbsd,dv->mbsv", out, params["head"])
+            losses = jax.vmap(tfm.causal_lm_loss)(logits, tokens_ref)
+            loss = jax.lax.pmean(jnp.mean(losses), axis)
+            if data_axis is not None:
+                loss = jax.lax.pmean(loss, data_axis)
+            return loss
+
+        x_spec = P(axis, data_axis, None, None) if data_axis else P(axis)
+        tok_spec = P(axis, data_axis, None) if data_axis else P(axis)
+        shard = jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(
+                jax.tree.map(lambda _: P(axis), params["stages"]),
+                x_spec,
+                tok_spec,
+            ),
+            out_specs=P(),
+        )
+        return shard(params["stages"], x, tokens_micro)
+
+    def tail_loss(tail, y, tgt):
+        # one microbatch's head+loss: y [mb, seq, d], tgt [mb, seq]
+        out = norm_module.apply({"params": tail["norm"]}, y)
+        logits = jnp.einsum("bsd,dv->bsv", out, tail["head"])
+        return tfm.causal_lm_loss(logits, tgt)
+
+    def loss_and_grads_1f1b(params, tokens_micro):
+        x, vjp_emb = jax.vjp(
+            lambda e: jnp.take(e, tokens_micro, axis=0), params["embed"]
+        )
+        tail = {"norm": params["norm"], "head": params["head"]}
+
+        def body(stages, tail_in, x_micro, tok_micro):
+            loss, dstage, dtail, dx = pipeline_1f1b(
+                stage_fn, tail_loss, stages, tail_in, x_micro, tok_micro,
+                axis_name=axis,
+            )
+            if data_axis is not None:  # DP: mean loss and grads over data
+                loss = jax.lax.pmean(loss, data_axis)
+                dstage = jax.tree.map(
+                    lambda g: jax.lax.pmean(g, data_axis), dstage
+                )
+                dtail = jax.tree.map(
+                    lambda g: jax.lax.pmean(g, data_axis), dtail
+                )
+                # dx shards stay per-data-replica (vjp_emb sum-scatters
+                # them into the SHARED embedding) — scale to match the
+                # pmean'd loss the other gradients differentiate
+                dx = dx / jax.lax.axis_size(data_axis)
+            return loss, dstage, dtail, dx
+
+        x_spec = P(axis, data_axis, None, None) if data_axis else P(axis)
+        tok_spec = P(axis, data_axis, None) if data_axis else P(axis)
+        stage_spec = jax.tree.map(lambda _: P(axis), params["stages"])
+        tail_spec = jax.tree.map(lambda _: P(), tail)
+        shard = jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(stage_spec, tail_spec, x_spec, tok_spec),
+            out_specs=(P(), stage_spec, tail_spec, x_spec),
+        )
+        loss, dstage, dtail, dx = shard(
+            params["stages"], tail, x, tokens_micro
+        )
+        (d_embed,) = vjp_emb(dx)
+        grads = {
+            "stages": dstage,
+            "embed": d_embed,
+            "head": dtail["head"],
+            "norm": dtail["norm"],
+        }
+        return loss, grads
+
+    def step_fn(params, opt_state, tokens_micro):
+        if schedule == "1f1b":
+            loss, grads = loss_and_grads_1f1b(params, tokens_micro)
+        else:
+            loss, grads = jax.value_and_grad(loss_from)(params, tokens_micro)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return (
+        jax.jit(step_fn, donate_argnums=(0, 1)),
+        jax.jit(loss_from),
+        stage_module,
+        norm_module,
+        tx,
+    )
 
 
 class PipelinedLMTrainer:
@@ -143,6 +480,7 @@ class PipelinedLMTrainer:
         n_micro: int = 4,
         learning_rate: float = 1e-3,
         seed: int = 0,
+        schedule: str = "gpipe",
         dashboard=None,
     ) -> None:
         import optax
@@ -176,20 +514,16 @@ class PipelinedLMTrainer:
         self.mesh = mesh
         self.n_micro = n_micro
         self.n_stages = n_stages
-        per_stage = cfg.n_layers // n_stages
 
-        # one flax module = one stage (per_stage sequential blocks)
-        stage_cfg_layers = per_stage
-
-        class Stage(tfm.nn.Module):  # type: ignore[name-defined]
-            @tfm.nn.compact
-            def __call__(self, x):
-                positions = jnp.arange(x.shape[1])[None, :]
-                for _ in range(stage_cfg_layers):
-                    x = tfm.Block(cfg)(x, positions)
-                return x
-
-        self.stage_module = Stage()
+        (
+            self._step,
+            self._loss,
+            self.stage_module,
+            self.norm_module,
+            self.tx,
+        ) = make_pp_step(
+            cfg, mesh, learning_rate=learning_rate, schedule=schedule
+        )
         key = jax.random.PRNGKey(seed)
         keys = jax.random.split(key, n_stages + 3)
         x0 = jnp.zeros((1, 8, cfg.d_model), jnp.float32)
@@ -227,11 +561,9 @@ class PipelinedLMTrainer:
         # the canonical body (models/transformer._apply_body) normalizes the
         # residual stream after the block stack; omitting it here would make
         # PP train a subtly different model than the other trainers
-        self.norm_module = tfm.Norm(cfg.norm)
         self.norm = jax.device_put(
             self.norm_module.init(norm_key, x0)["params"], repl
         )
-        self.tx = optax.adamw(learning_rate)
         params0 = {
             "stages": self.stage_params,
             "embed": self.embed,
@@ -254,67 +586,6 @@ class PipelinedLMTrainer:
 
         with mesh:
             self.opt_state = jax.jit(_init_opt)(params0)
-
-        stage_module, tx, axis = self.stage_module, self.tx, PP_AXIS
-        norm_module = self.norm_module
-        #: DP composition: a "data" axis beside "pp" shards the microbatch
-        #: rows; every device still runs the same pipeline schedule and the
-        #: loss pmean over "data" (whose grads transpose to the psum) is the
-        #: usual DP gradient allreduce.
-        from parameter_server_tpu.parallel.mesh import DATA_AXIS
-
-        data_axis = DATA_AXIS if DATA_AXIS in mesh.axis_names else None
-
-        def stage_fn(stage_params_local, x):
-            # shard_map hands the local slice with a leading length-1 stage
-            # axis; peel it for the module
-            local = jax.tree.map(lambda a: a[0], stage_params_local)
-            return stage_module.apply({"params": local}, x)
-
-        def loss_from(params, tokens_micro):
-            # tokens_micro: [n_micro, mb, seq] int32; the microbatch axis is
-            # SHARDED over pp (each stage owns n_micro/S microbatches end to
-            # end — VERDICT r3 #8's O(M/S) injection buffer), the mb axis
-            # over data when present
-            x = jnp.take(params["embed"], tokens_micro, axis=0)
-
-            def body(stages, x_micro, tokens_ref):
-                out = pipeline_apply(stage_fn, stages, x_micro, axis_name=axis)
-                out = norm_module.apply({"params": params["norm"]}, out)
-                logits = jnp.einsum("mbsd,dv->mbsv", out, params["head"])
-                # per-microbatch causal loss over THIS device's owned
-                # microbatches; every stage holds an equal share, so the
-                # global mean is the pp-pmean of local means
-                losses = jax.vmap(tfm.causal_lm_loss)(logits, tokens_ref)
-                loss = jax.lax.pmean(jnp.mean(losses), axis)
-                if data_axis is not None:  # DP: mean over batch shards
-                    loss = jax.lax.pmean(loss, data_axis)
-                return loss
-
-            x_spec = (
-                P(axis, data_axis, None, None) if data_axis else P(axis)
-            )
-            tok_spec = P(axis, data_axis, None) if data_axis else P(axis)
-            shard = jax.shard_map(
-                body,
-                mesh=self.mesh,
-                in_specs=(
-                    jax.tree.map(lambda _: P(axis), params["stages"]),
-                    x_spec,
-                    tok_spec,
-                ),
-                out_specs=P(),
-            )
-            return shard(params["stages"], x, tokens_micro)
-
-        def step_fn(params, opt_state, tokens_micro):
-            loss, grads = jax.value_and_grad(loss_from)(params, tokens_micro)
-            updates, opt_state = tx.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            return params, opt_state, loss
-
-        self._step = jax.jit(step_fn, donate_argnums=(0, 1))
-        self._loss = jax.jit(loss_from)
 
         # MFU wiring (VERDICT r3 weak #4): 6ND over the matmul-participating
         # params — the full stage stack (the stacked leading axis sums all

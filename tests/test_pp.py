@@ -232,3 +232,106 @@ def test_pipeline_composes_with_dp():
     losses = [dp_pp.step(_tokens(cfg, rng)) for _ in range(8)]
     assert np.isfinite(losses).all()
     assert np.mean(losses[-2:]) < np.mean(losses[:2])
+
+
+def test_1f1b_matches_gpipe_trajectory():
+    """schedule="1f1b" (manual interleaved backward) must produce the SAME
+    training trajectory as the AD-through-scan GPipe schedule — identical
+    math, different tick order (VERDICT r4 #9)."""
+    cfg = tfm.tiny_config(
+        causal=True, tie_embeddings=False, n_layers=4, n_kv_heads=4
+    )
+    mesh = _pp_mesh(4)
+    rng = np.random.default_rng(0)
+    toks = [_tokens(cfg, rng) for _ in range(3)]
+    tg = PipelinedLMTrainer(cfg, mesh, n_micro=8, seed=0)
+    t1 = PipelinedLMTrainer(cfg, mesh, n_micro=8, seed=0, schedule="1f1b")
+    lg = [tg.step(t) for t in toks]
+    l1 = [t1.step(t) for t in toks]
+    np.testing.assert_allclose(lg, l1, rtol=2e-5, atol=1e-6)
+
+
+def test_1f1b_composes_with_dp():
+    """DP x PP with the manual 1F1B backward: the embedding gradient must
+    carry the data-pmean scaling (a sum-scatter of per-replica dx would be
+    n_data x too large — caught in review), so the trajectory must equal
+    GPipe's on the same (data, pp) mesh and stream."""
+    cfg = tfm.tiny_config(
+        causal=True, tie_embeddings=False, n_layers=4, n_kv_heads=4
+    )
+    mesh = Mesh(np.asarray(jax.devices()).reshape(2, 4), ("data", "pp"))
+    rng = np.random.default_rng(0)
+    toks = [_tokens(cfg, rng, batch=16) for _ in range(3)]
+    tg = PipelinedLMTrainer(cfg, mesh, n_micro=8, seed=0)
+    t1 = PipelinedLMTrainer(cfg, mesh, n_micro=8, seed=0, schedule="1f1b")
+    lg = [tg.step(t) for t in toks]
+    l1 = [t1.step(t) for t in toks]
+    np.testing.assert_allclose(lg, l1, rtol=2e-5, atol=1e-6)
+
+
+def test_1f1b_memory_is_microbatch_independent():
+    """1F1B's point: compiled temp memory stays ~flat as M grows (O(S)
+    stash) while GPipe's saved residuals grow O(M).  Measured via XLA's
+    own memory analysis of the compiled steps."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from parameter_server_tpu.parallel import pp as pp_lib
+
+    cfg = tfm.tiny_config(
+        causal=True, tie_embeddings=False, n_layers=4, n_kv_heads=4,
+        d_model=128, d_ff=256, max_seq=128,
+    )
+    mesh = _pp_mesh(4)
+
+    def temps(schedule, n_micro):
+        step, _l, stage_module, norm_module, tx = pp_lib.make_pp_step(
+            cfg, mesh, schedule=schedule
+        )
+        x0 = jnp.zeros((1, 8, cfg.d_model), jnp.float32)
+        st_shapes = jax.eval_shape(
+            lambda k: jax.vmap(
+                lambda kk: stage_module.init(kk, x0)["params"]
+            )(k),
+            jax.ShapeDtypeStruct((4, 2), jnp.uint32),
+        )
+        st_shard = pp_lib.stage_sharding(mesh, st_shapes)
+        repl = NamedSharding(mesh, P())
+        params = {
+            "stages": jax.tree.map(
+                lambda s, sh: jax.ShapeDtypeStruct(
+                    s.shape, s.dtype, sharding=sh
+                ),
+                st_shapes, st_shard,
+            ),
+            "embed": jax.ShapeDtypeStruct(
+                (cfg.vocab_size, cfg.d_model), jnp.float32, sharding=repl
+            ),
+            "head": jax.ShapeDtypeStruct(
+                (cfg.d_model, cfg.vocab_size), jnp.float32, sharding=repl
+            ),
+            "norm": jax.tree.map(
+                lambda s: jax.ShapeDtypeStruct(
+                    s.shape, s.dtype, sharding=repl
+                ),
+                jax.eval_shape(
+                    lambda: norm_module.init(
+                        jax.random.PRNGKey(0), x0
+                    )["params"]
+                ),
+            ),
+        }
+        opt = jax.eval_shape(tx.init, params)
+        tok = jax.ShapeDtypeStruct(
+            (n_micro, 2, 128), jnp.int32,
+            sharding=NamedSharding(mesh, P("pp")),
+        )
+        with mesh:
+            c = step.lower(params, opt, tok).compile()
+        return int(c.memory_analysis().temp_size_in_bytes)
+
+    g_ratio = temps("gpipe", 32) / temps("gpipe", 8)
+    f_ratio = temps("1f1b", 32) / temps("1f1b", 8)
+    # measured: ~2.4x vs ~1.2x; margins generous against XLA version drift
+    assert g_ratio > 1.7, g_ratio
+    assert f_ratio < 1.45, f_ratio
+    assert f_ratio < g_ratio - 0.4, (f_ratio, g_ratio)
