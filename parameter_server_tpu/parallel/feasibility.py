@@ -539,7 +539,22 @@ def pp_vs_dp_feasibility(
             ),
         ),
     }
-    pp_opt = jax.eval_shape(_tx.init, pp_params)
+    # PIN the adamw moment shardings to the params' (stage moments
+    # pp-sharded, tail replicated): eval_shape drops shardings, and an
+    # unpinned ~2x-param-bytes moment tree left to GSPMD's discretion
+    # could replicate — the 12 GB/device verdict must not depend on that
+    pp_param_shardings = {
+        "stages": st_shard,
+        "embed": repl,
+        "head": repl,
+        "norm": jax.tree.map(lambda _: repl, pp_params["norm"]),
+    }
+    pp_opt = optax.tree_map_params(
+        _tx,
+        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        jax.eval_shape(_tx.init, pp_params),
+        pp_param_shardings,
+    )
     tok_pp = jax.ShapeDtypeStruct(
         (n_micro, micro_batch, seq), jnp.int32,
         sharding=NamedSharding(mesh_pp, P(PP_AXIS)),
@@ -614,6 +629,18 @@ def main(argv=None) -> int:
     p.add_argument("--dtype", default=None, help="e.g. bfloat16")
     args = p.parse_args(argv)
     if args.preset == "pp-vs-dp":
+        # this preset exposes ONLY --seq; silently computing a fixed
+        # config while echoing back a user's other knobs would label
+        # numbers with a configuration that was never compiled
+        ignored = {
+            "--mesh": args.mesh, "--batch": args.batch, "--dtype": args.dtype
+        }
+        bad = [k for k, v in ignored.items() if v is not None]
+        if bad:
+            p.error(
+                f"--preset pp-vs-dp supports only --seq; got {bad} "
+                "(edit pp_vs_dp_feasibility's keywords for other shapes)"
+            )
         result = pp_vs_dp_feasibility(
             seq=args.seq if args.seq is not None else 1024
         )

@@ -343,6 +343,17 @@ def make_pp_step(
     n_stages = mesh.shape[PP_AXIS]
     if cfg.n_layers % n_stages:
         raise ValueError(f"n_layers {cfg.n_layers} % pp {n_stages} != 0")
+    if cfg.positional != "rotary":
+        # learned positional embeddings are a stage-0-only parameter and
+        # would break the uniform per-stage weight stacking — and Stage
+        # below never adds them, so a learned-pos config would silently
+        # train with NO positional signal; rotary is positionless state.
+        # Guard HERE (the shared entry): the feasibility path calls this
+        # directly, not through the trainer.
+        raise ValueError(
+            "make_pp_step requires cfg.positional == 'rotary'; "
+            f"got {cfg.positional!r}"
+        )
     per_stage = cfg.n_layers // n_stages
 
     class Stage(tfm.nn.Module):  # type: ignore[name-defined]
@@ -358,6 +369,10 @@ def make_pp_step(
     tx = optax.adamw(learning_rate)
     data_axis = DATA_AXIS if DATA_AXIS in mesh.axis_names else None
     axis = PP_AXIS
+    # ONE definition of the input specs for both schedules (the GPipe and
+    # 1F1B paths must stay spec-identical or trajectory parity breaks)
+    x_spec = P(axis, data_axis, None, None) if data_axis else P(axis)
+    tok_spec = P(axis, data_axis, None) if data_axis else P(axis)
 
     def stage_fn(stage_params_local, x):
         local = jax.tree.map(lambda a: a[0], stage_params_local)
@@ -376,8 +391,6 @@ def make_pp_step(
                 loss = jax.lax.pmean(loss, data_axis)
             return loss
 
-        x_spec = P(axis, data_axis, None, None) if data_axis else P(axis)
-        tok_spec = P(axis, data_axis, None) if data_axis else P(axis)
         shard = jax.shard_map(
             body,
             mesh=mesh,
@@ -421,8 +434,6 @@ def make_pp_step(
                 dx = dx / jax.lax.axis_size(data_axis)
             return loss, dstage, dtail, dx
 
-        x_spec = P(axis, data_axis, None, None) if data_axis else P(axis)
-        tok_spec = P(axis, data_axis, None) if data_axis else P(axis)
         stage_spec = jax.tree.map(lambda _: P(axis), params["stages"])
         tail_spec = jax.tree.map(lambda _: P(), tail)
         shard = jax.shard_map(
@@ -502,14 +513,8 @@ class PipelinedLMTrainer:
             raise ValueError(
                 f"n_micro {n_micro} % pp stages {n_stages} != 0"
             )
-        if cfg.positional != "rotary":
-            # learned positional embeddings are a stage-0-only parameter and
-            # would break the uniform per-stage weight stacking; rotary is
-            # positionless state (computed per block from indices)
-            raise ValueError(
-                "PipelinedLMTrainer requires cfg.positional == 'rotary'; "
-                f"got {cfg.positional!r}"
-            )
+        # (positional == 'rotary' is enforced by make_pp_step — the shared
+        # entry the feasibility path also uses)
         self.cfg = cfg
         self.mesh = mesh
         self.n_micro = n_micro

@@ -320,7 +320,20 @@ def test_1f1b_memory_is_microbatch_independent():
                 ),
             ),
         }
-        opt = jax.eval_shape(tx.init, params)
+        import optax
+
+        param_shardings = {
+            "stages": st_shard,
+            "embed": repl,
+            "head": repl,
+            "norm": jax.tree.map(lambda _: repl, params["norm"]),
+        }
+        opt = optax.tree_map_params(
+            tx,
+            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+            jax.eval_shape(tx.init, params),
+            param_shardings,
+        )
         tok = jax.ShapeDtypeStruct(
             (n_micro, 2, 128), jnp.int32,
             sharding=NamedSharding(mesh, P("pp")),
