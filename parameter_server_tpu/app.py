@@ -314,42 +314,104 @@ def _build_llama_hybrid(cfg: AppConfig) -> Callable[[], dict]:
     return run
 
 
+def _sp_app_knobs(cfg: AppConfig, round_to: int):
+    """Shared knobs of the long-context apps (sp_lm / sptp_lm).
+
+    One source for the model config, sequence length (``data.nnz * 64``
+    rounded up to ``round_to`` — nnz reused as a length knob so the app
+    config stays one schema), batch rows, and the synthetic token stream.
+    """
+    import numpy as np
+
+    from parameter_server_tpu.models import transformer as tfm
+
+    model_cfg = tfm.tiny_config(
+        causal=True, tie_embeddings=False,
+        vocab_size=min(cfg.data.key_space, 1 << 16),
+        max_seq=1 << 16,
+    )
+    seq = max(cfg.data.nnz, 1) * 64
+    seq = ((seq + round_to - 1) // round_to) * round_to
+    B = max(cfg.data.batch_size // 256, 1)
+    rng = np.random.default_rng(cfg.data.seed)
+
+    def next_tokens() -> np.ndarray:
+        base = rng.integers(0, model_cfg.vocab_size, size=(B, 1))
+        return (
+            (base + np.arange(seq)[None]) % model_cfg.vocab_size
+        ).astype(np.int32)
+
+    return model_cfg, seq, next_tokens
+
+
 @register_app("sp_lm")
 def _build_sp_lm(cfg: AppConfig) -> Callable[[], dict]:
     """Long-context causal LM: the sequence axis sharded over EVERY device
     (``parallel/sp_lm.py``), ring attention inside the transformer.  The
     vocab is ``data.key_space`` (kept small by default); ``data.batch_size``
-    is the batch; the sequence length is ``data.nnz * 64`` rounded up to a
-    multiple of the device count (nnz reused as a length knob so the app
-    config stays one schema)."""
+    is the batch; seq-length knob per ``_sp_app_knobs``."""
 
     def run() -> dict:
         import jax
         import numpy as np
         from jax.sharding import Mesh
 
-        from parameter_server_tpu.models import transformer as tfm
         from parameter_server_tpu.parallel.sp_lm import SpLMTrainer
 
         devices = jax.devices()
-        n_dev = len(devices)
-        model_cfg = tfm.tiny_config(
-            causal=True, tie_embeddings=False,
-            vocab_size=min(cfg.data.key_space, 1 << 16),
-            max_seq=1 << 16,
-        )
-        seq = max(cfg.data.nnz, 1) * 64
-        seq = ((seq + n_dev - 1) // n_dev) * n_dev
+        model_cfg, seq, next_tokens = _sp_app_knobs(cfg, len(devices))
         mesh = Mesh(np.asarray(devices), ("sp",))
         trainer = SpLMTrainer(model_cfg, mesh, learning_rate=3e-3)
-        rng = np.random.default_rng(cfg.data.seed)
-        B = max(cfg.data.batch_size // 256, 1)
-        losses = []
-        for _ in range(cfg.steps):
-            base = rng.integers(0, model_cfg.vocab_size, size=(B, 1))
-            tokens = (base + np.arange(seq)[None]) % model_cfg.vocab_size
-            losses.append(trainer.step(tokens.astype(np.int32)))
+        losses = [trainer.step(next_tokens()) for _ in range(cfg.steps)]
         return {"losses": losses, "steps": cfg.steps, "seq": seq}
+
+    return run
+
+
+@register_app("sptp_lm")
+def _build_sptp_lm(cfg: AppConfig) -> Callable[[], dict]:
+    """The COMPOSED long-context causal LM (``parallel/sp_fsdp.py``): ring
+    attention over an ``sp`` axis x tensor parallelism over ``model`` x
+    adamw moments FSDP over ``sp``, one GSPMD program.  Mesh shape comes
+    from ``topology.mesh_shape`` (data, model) reinterpreted as
+    (sp, model) — falls back to all-devices-on-sp x model 1.  Sequence
+    length knob as in the ``sp_lm`` app (``data.nnz * 64``, rounded to a
+    multiple of sp)."""
+
+    def run() -> dict:
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
+        from parameter_server_tpu.parallel.sp_fsdp import SpTpLMTrainer
+
+        devices = jax.devices()
+        n_dev = len(devices)
+        mesh_cfg = tuple(cfg.topology.mesh_shape)
+        if mesh_cfg == (1, 1):  # schema default: all devices on sp, no TP
+            sp_n, tp_n = n_dev, 1
+        elif len(mesh_cfg) == 2 and mesh_cfg[0] * mesh_cfg[1] == n_dev:
+            sp_n, tp_n = mesh_cfg
+        else:
+            # a silently-substituted mesh would run the "composed SP x TP"
+            # app with no TP at all; fail the misconfiguration loudly
+            raise ValueError(
+                f"topology.mesh_shape {mesh_cfg} does not factor the "
+                f"{n_dev} available devices into (sp, model)"
+            )
+        model_cfg, seq, next_tokens = _sp_app_knobs(cfg, sp_n)
+        mesh = Mesh(
+            np.asarray(devices).reshape(sp_n, tp_n), ("sp", "model")
+        )
+        trainer = SpTpLMTrainer(
+            model_cfg, mesh, learning_rate=3e-3, fsdp="state",
+            loss_chunk=max(seq // (4 * sp_n), 8),
+        )
+        losses = [trainer.step(next_tokens()) for _ in range(cfg.steps)]
+        return {
+            "losses": losses, "steps": cfg.steps, "seq": seq,
+            "mesh": {"sp": sp_n, "model": tp_n},
+        }
 
     return run
 

@@ -209,3 +209,29 @@ def test_sp_lm_app_runs_from_config():
     assert result["steps"] == 2
     assert np.all(np.isfinite(result["losses"]))
     assert result["seq"] % 8 == 0  # divisible by the 8-device mesh
+
+
+def test_sptp_lm_app_runs_from_config():
+    """The COMPOSED SP x TP long-context trainer is reachable from the
+    config-driven app surface; topology.mesh_shape picks (sp, model)."""
+    from parameter_server_tpu import app as app_lib
+    from parameter_server_tpu.config import (
+        OptimizerConfig, TableConfig, TopologyConfig,
+    )
+
+    cfg = app_lib.AppConfig(
+        app="sptp_lm",
+        table=TableConfig(
+            name="emb", rows=256, dim=1,
+            optimizer=OptimizerConfig(kind="adagrad"),
+        ),
+        data=app_lib.DataConfig(kind="synthetic", key_space=256, nnz=2,
+                                batch_size=512, seed=0),
+        topology=TopologyConfig(mesh_shape=(4, 2)),
+        steps=2,
+    )
+    result = app_lib.create(cfg)()
+    assert result["steps"] == 2
+    assert np.all(np.isfinite(result["losses"]))
+    assert result["mesh"] == {"sp": 4, "model": 2}
+    assert result["seq"] % 4 == 0
