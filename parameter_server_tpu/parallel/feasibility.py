@@ -421,6 +421,87 @@ def sp_8b_feasibility(
     return out
 
 
+def _compile_pp_step_aot(cfg, mesh, *, tp, n_micro, micro_batch, seq):
+    """AOT-compile one ``make_pp_step`` train step from ShapeDtypeStructs.
+
+    Shared PP harness for the pp-vs-dp and pp-x-tp feasibility checks:
+    stage stack sharded by ``stage_sharding(tp=...)``, embed/head
+    replicated (tp=False) or TP-sharded per the PS/Megatron rules
+    (tp=True), and the adamw moment shardings PINNED to the params' —
+    ``eval_shape`` drops shardings, and a multi-GB moment tree left to
+    GSPMD's discretion could replicate, which would make the per-device
+    verdicts depend on compiler whim.  Returns XLA's memory_analysis and
+    the body-stack param count.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from parameter_server_tpu.parallel.pp import (
+        PP_AXIS, make_pp_step, stage_sharding,
+    )
+
+    del Mesh  # mesh comes in ready-made
+    n_stages = mesh.shape[PP_AXIS]
+    step, _loss, stage_module, norm_module, tx = make_pp_step(
+        cfg, mesh, learning_rate=1e-3, tp=tp
+    )
+    x0 = jnp.zeros((1, 8, cfg.d_model), jnp.float32)
+    stage_shapes = jax.eval_shape(
+        lambda k: jax.vmap(
+            lambda kk: stage_module.init(kk, x0)["params"]
+        )(k),
+        jax.ShapeDtypeStruct((n_stages, 2), jnp.uint32),
+    )
+    st_shard = stage_sharding(mesh, stage_shapes, tp=tp)
+    repl = NamedSharding(mesh, P())
+    emb_sh = NamedSharding(mesh, P("model", None)) if tp else repl
+    head_sh = NamedSharding(mesh, P(None, "model")) if tp else repl
+    vocab, d_model = cfg.vocab_size, cfg.d_model
+    pp_params = {
+        "stages": jax.tree.map(
+            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+            stage_shapes, st_shard,
+        ),
+        "embed": jax.ShapeDtypeStruct(
+            (vocab, d_model), jnp.float32, sharding=emb_sh
+        ),
+        "head": jax.ShapeDtypeStruct(
+            (d_model, vocab), jnp.float32, sharding=head_sh
+        ),
+        "norm": jax.tree.map(
+            lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=repl),
+            jax.eval_shape(
+                lambda: norm_module.init(jax.random.PRNGKey(0), x0)["params"]
+            ),
+        ),
+    }
+    param_shardings = {
+        "stages": st_shard,
+        "embed": emb_sh,
+        "head": head_sh,
+        "norm": jax.tree.map(lambda _: repl, pp_params["norm"]),
+    }
+    pp_opt = optax.tree_map_params(
+        tx,
+        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        jax.eval_shape(tx.init, pp_params),
+        param_shardings,
+    )
+    tok = jax.ShapeDtypeStruct(
+        (n_micro, micro_batch, seq), jnp.int32,
+        sharding=NamedSharding(mesh, P(PP_AXIS)),
+    )
+    with mesh:
+        compiled = step.lower(pp_params, pp_opt, tok).compile()
+    n_stack = sum(
+        int(np.prod(s.shape)) for s in jax.tree.leaves(stage_shapes)
+    )
+    return compiled.memory_analysis(), n_stack
+
+
 def pp_vs_dp_feasibility(
     *,
     n_stages: int = 4,
@@ -505,63 +586,14 @@ def pp_vs_dp_feasibility(
     dp_ma = dp_compiled.memory_analysis()
     dp_peak = peak_bytes_from_analysis(dp_ma)
 
-    # -- PP side: the same model over pp stages ----------------------------
+    # -- PP side: the same model over pp stages (shared AOT harness;
+    # rotary has no positional params; untied embed/head like the trainer)
     devices = np.asarray(jax.devices()[:n_stages])
     mesh_pp = Mesh(devices.reshape(n_stages), (PP_AXIS,))
-    # rotary has no positional params; untie embed/head like the trainer
-    step, _loss, stage_module, norm_module, _tx = make_pp_step(
-        cfg, mesh_pp, learning_rate=1e-3
+    pp_ma, _n_stack = _compile_pp_step_aot(
+        cfg, mesh_pp, tp=False, n_micro=n_micro,
+        micro_batch=micro_batch, seq=seq,
     )
-    x0 = jnp.zeros((1, 8, cfg.d_model), jnp.float32)
-    stage_shapes = jax.eval_shape(
-        lambda k: jax.vmap(
-            lambda kk: stage_module.init(kk, x0)["params"]
-        )(k),
-        jax.ShapeDtypeStruct((n_stages, 2), jnp.uint32),
-    )
-    st_shard = stage_sharding(mesh_pp, stage_shapes)
-    repl = NamedSharding(mesh_pp, P())
-    pp_params = {
-        "stages": jax.tree.map(
-            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
-            stage_shapes, st_shard,
-        ),
-        "embed": jax.ShapeDtypeStruct(
-            (vocab, d_model), jnp.float32, sharding=repl
-        ),
-        "head": jax.ShapeDtypeStruct(
-            (d_model, vocab), jnp.float32, sharding=repl
-        ),
-        "norm": jax.tree.map(
-            lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=repl),
-            jax.eval_shape(
-                lambda: norm_module.init(jax.random.PRNGKey(0), x0)["params"]
-            ),
-        ),
-    }
-    # PIN the adamw moment shardings to the params' (stage moments
-    # pp-sharded, tail replicated): eval_shape drops shardings, and an
-    # unpinned ~2x-param-bytes moment tree left to GSPMD's discretion
-    # could replicate — the 12 GB/device verdict must not depend on that
-    pp_param_shardings = {
-        "stages": st_shard,
-        "embed": repl,
-        "head": repl,
-        "norm": jax.tree.map(lambda _: repl, pp_params["norm"]),
-    }
-    pp_opt = optax.tree_map_params(
-        _tx,
-        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
-        jax.eval_shape(_tx.init, pp_params),
-        pp_param_shardings,
-    )
-    tok_pp = jax.ShapeDtypeStruct(
-        (n_micro, micro_batch, seq), jnp.int32,
-        sharding=NamedSharding(mesh_pp, P(PP_AXIS)),
-    )
-    with mesh_pp:
-        pp_compiled = step.lower(pp_params, pp_opt, tok_pp).compile()
-    pp_ma = pp_compiled.memory_analysis()
     pp_peak = peak_bytes_from_analysis(pp_ma)
 
     n_params = sum(int(np.prod(s.shape)) for s in jax.tree.leaves(p_shapes))
@@ -588,6 +620,73 @@ def pp_vs_dp_feasibility(
     }
 
 
+def pp_tp_feasibility(
+    *,
+    n_stages: int = 8,
+    tp: int = 8,
+    n_micro: int = 8,
+    micro_batch: int = 1,
+    seq: int = 2048,
+    vocab: int = 32_000,
+    n_layers: int = 48,
+    d_model: int = 7168,
+    d_ff: int = 19_456,
+    n_heads: int = 56,
+    n_kv_heads: int = 8,
+) -> dict:
+    """Depth x width: PP x TP for a body TP+FSDP alone cannot hold.
+
+    The ~26B fp32-adamw LM here carries ~420 GB of train state — far over
+    a v5e-16 even fully sharded; a (pp=8, model=8) v5e-64 mesh splits the
+    stack 64 ways (``stage_sharding(tp=True)``: stage axis x the TP rules)
+    while the microbatch pipeline keeps activations O(M/S) per device.
+    AOT-compiled from ShapeDtypeStructs; XLA's own per-device verdict.
+    Needs ``n_stages * tp`` virtual devices
+    (``--xla_force_host_platform_device_count=64`` at the defaults).
+    """
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from parameter_server_tpu.models import transformer as tfm
+    from parameter_server_tpu.parallel.pp import PP_AXIS
+
+    n_dev = n_stages * tp
+    if len(jax.devices()) < n_dev:
+        raise RuntimeError(
+            f"pp_tp_feasibility needs {n_dev} devices (pp={n_stages} x "
+            f"tp={tp}), have {len(jax.devices())} — set "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={n_dev}"
+        )
+    cfg = tfm.TransformerConfig(
+        vocab_size=vocab, n_layers=n_layers, n_heads=n_heads,
+        n_kv_heads=n_kv_heads, d_model=d_model, d_ff=d_ff, max_seq=seq,
+    )
+    devices = np.asarray(jax.devices()[:n_dev])
+    mesh = Mesh(devices.reshape(n_stages, tp), (PP_AXIS, "model"))
+    ma, n_stack = _compile_pp_step_aot(
+        cfg, mesh, tp=True, n_micro=n_micro,
+        micro_batch=micro_batch, seq=seq,
+    )
+    n_params = n_stack + vocab * d_model * 2 + d_model  # + final norm scale
+    out = {
+        "n_params": n_params,
+        "mesh": {"pp": n_stages, "model": tp},
+        "devices": n_dev,
+        "n_micro": n_micro,
+        "micro_batch": micro_batch,
+        "seq": seq,
+        "argument_bytes": int(ma.argument_size_in_bytes),
+        "output_bytes": int(ma.output_size_in_bytes),
+        "temp_bytes": int(ma.temp_size_in_bytes),
+        "alias_bytes": int(ma.alias_size_in_bytes),
+        "generated_code_bytes": int(ma.generated_code_size_in_bytes),
+    }
+    out["peak_bytes"] = peak_bytes_from_analysis(ma)
+    out["fits_v5e"] = out["peak_bytes"] <= V5E_HBM_BYTES
+    return out
+
+
 def main(argv=None) -> int:
     # the dev image's sitecustomize registers the axon TPU plugin before
     # JAX_PLATFORMS=cpu is consulted; a CPU-sim analysis must never dial the
@@ -601,7 +700,7 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--preset", default="llama3-8b",
                    choices=["llama3-8b", "llama3-8b-sp", "dlrm-1b",
-                            "pp-vs-dp"])
+                            "pp-vs-dp", "pp-tp-26b"])
     p.add_argument("--mesh", default=None,
                    help="data,model mesh shape (product = device count); "
                    "default 2,8 (llama3-8b) / 1,16 (dlrm-1b)")
@@ -628,8 +727,8 @@ def main(argv=None) -> int:
                    default=True)
     p.add_argument("--dtype", default=None, help="e.g. bfloat16")
     args = p.parse_args(argv)
-    if args.preset == "pp-vs-dp":
-        # this preset exposes ONLY --seq; silently computing a fixed
+    if args.preset in ("pp-tp-26b", "pp-vs-dp"):
+        # these presets expose ONLY --seq; silently computing a fixed
         # config while echoing back a user's other knobs would label
         # numbers with a configuration that was never compiled
         ignored = {
@@ -638,9 +737,14 @@ def main(argv=None) -> int:
         bad = [k for k, v in ignored.items() if v is not None]
         if bad:
             p.error(
-                f"--preset pp-vs-dp supports only --seq; got {bad} "
-                "(edit pp_vs_dp_feasibility's keywords for other shapes)"
+                f"--preset {args.preset} supports only --seq; got {bad} "
+                "(edit the feasibility function's keywords for other shapes)"
             )
+    if args.preset == "pp-tp-26b":
+        result = pp_tp_feasibility(
+            seq=args.seq if args.seq is not None else 2048
+        )
+    elif args.preset == "pp-vs-dp":
         result = pp_vs_dp_feasibility(
             seq=args.seq if args.seq is not None else 1024
         )

@@ -308,16 +308,33 @@ def stack_stage_params(per_stage_params) -> object:
     return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage_params)
 
 
-def stage_sharding(mesh: Mesh, tree) -> object:
-    """Shard stage-stacked params: leading axis over ``pp``, rest unsharded."""
-    def spec(leaf):
-        return NamedSharding(mesh, P(PP_AXIS, *(None,) * (leaf.ndim - 1)))
+def stage_sharding(mesh: Mesh, tree, *, tp: bool = False) -> object:
+    """Shard stage-stacked params: leading axis over ``pp``.
 
-    return jax.tree.map(spec, tree)
+    ``tp=True`` additionally applies the tensor-parallel rules
+    (``parallel/tp.py``) to the tail dims over the ``model`` axis — the
+    PP x TP composition: each device holds 1/(S x TP) of the stack.
+    """
+    if not tp:
+        return jax.tree.map(
+            lambda leaf: NamedSharding(
+                mesh, P(PP_AXIS, *(None,) * (leaf.ndim - 1))
+            ),
+            tree,
+        )
+    from parameter_server_tpu.parallel.tp import _spec_for, _TailView
+
+    def spec(path, leaf):
+        names = tuple(p.key if hasattr(p, "key") else str(p) for p in path)
+        tail = _spec_for(names, _TailView(leaf))
+        return NamedSharding(mesh, P(PP_AXIS, *tail))
+
+    return jax.tree_util.tree_map_with_path(spec, tree)
 
 
 def make_pp_step(
-    cfg, mesh: Mesh, *, learning_rate: float = 1e-3, schedule: str = "gpipe"
+    cfg, mesh: Mesh, *, learning_rate: float = 1e-3,
+    schedule: str = "gpipe", tp: bool = False,
 ):
     """Build the jitted PP train step WITHOUT materializing any params.
 
@@ -329,6 +346,13 @@ def make_pp_step(
     ``schedule``: "gpipe" (AD through the scanned pipeline; O(M) saved
     residuals per device) or "1f1b" (``pipeline_1f1b``'s manual interleaved
     backward; O(S) stash — same math, same FLOPs, M-independent memory).
+
+    ``tp=True`` composes the pipeline with tensor parallelism: the mesh
+    carries a ``model`` axis that stays AUTO (GSPMD) while only pp/data go
+    manual in the shard_map — stage weights shard over BOTH the stage and
+    the model axes (``stage_sharding(tp=True)``), the same partial-manual
+    trick as ``ops.ring_attention_spmd``.  The depth x width sharding a
+    30B+ body needs (see ``feasibility.pp_tp_feasibility``).
 
     Returns ``(step_fn_jitted, loss_fn_jitted, stage_module, norm_module,
     tx)``; shardings ride on the inputs.
@@ -367,8 +391,17 @@ def make_pp_step(
     stage_module = Stage()
     norm_module = tfm.Norm(cfg.norm)
     tx = optax.adamw(learning_rate)
+    from parameter_server_tpu.parallel.mesh import MODEL_AXIS as _MODEL
+
+    if tp and _MODEL not in mesh.axis_names:
+        raise ValueError(
+            f"tp=True needs a {_MODEL!r} mesh axis, got {mesh.axis_names}"
+        )
     data_axis = DATA_AXIS if DATA_AXIS in mesh.axis_names else None
     axis = PP_AXIS
+    #: only pp (and data) go manual; a model axis, if present, stays AUTO
+    #: so GSPMD keeps distributing the TP'd weight math inside the stages
+    manual = frozenset(n for n in mesh.axis_names if n != _MODEL)
     # ONE definition of the input specs for both schedules (the GPipe and
     # 1F1B paths must stay spec-identical or trajectory parity breaks)
     x_spec = P(axis, data_axis, None, None) if data_axis else P(axis)
@@ -400,6 +433,7 @@ def make_pp_step(
                 tok_spec,
             ),
             out_specs=P(),
+            axis_names=manual,
         )
         return shard(params["stages"], x, tokens_micro)
 
@@ -441,6 +475,7 @@ def make_pp_step(
             mesh=mesh,
             in_specs=(stage_spec, tail_spec, x_spec, tok_spec),
             out_specs=(P(), stage_spec, tail_spec, x_spec),
+            axis_names=manual,
         )
         loss, dstage, dtail, dx = shard(
             params["stages"], tail, x, tokens_micro
