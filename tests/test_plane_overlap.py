@@ -1,0 +1,37 @@
+"""Smoke the overlapped embedding-plane harness (bench part c).
+
+The full 8B-shape measurement is a bench; here a small shape must drive
+the same machinery — TcpVan sockets, codec chain, device replies,
+prefetched pull + bounded-delay push against a body window — and return
+a well-formed record, so the --llama8b section cannot rot.
+"""
+
+import numpy as np
+import pytest
+
+import bench
+from parameter_server_tpu import native
+
+
+def test_emb_plane_overlapped_small_shape():
+    if native.load("tcpvan") is None:  # pragma: no cover
+        pytest.skip("no native toolchain for tcpvan")
+    r = bench._emb_plane_overlapped(
+        VOCAB=16384, D=256, B=8, S=256, steps=3, t_body_s=0.2,
+        filters="key_caching+int8",
+    )
+    assert r["steps"] == 3
+    assert len(r["exposure_ms"]) == 3
+    assert np.all(np.isfinite(r["exposure_ms"]))
+    # real bytes crossed the sockets, and int8 compressed them: the wire
+    # must be well under the raw f32 rows (2 directions) yet nonzero
+    assert 0 < r["wire_mb_per_step"] < 2 * r["raw_row_mb_per_step"]
+    assert r["unique_rows_per_step"] > 0
+    assert r["tokens_per_sec_overlapped"] > 0
+
+
+def test_plane_codec_microbench_shape():
+    c = bench._plane_codec_microbench(D=64, rows=500)
+    assert c["payload_mb"] > 0
+    assert c["quantize_ms"] >= 0 and c["dequantize_ms"] >= 0
+    assert -100 <= c["zlib_saves_pct"] <= 100
