@@ -1570,6 +1570,24 @@ _TTA_MODES = [
 #: with per-op waits approaching their 120 s timeouts is slow, not stuck)
 _TTA_RUN_BUDGET_S = 180.0
 
+#: part (b): the IMAGE half of the north-star quality clock ("Criteo LR,
+#: ResNet-50" — here a norm-free tiny CNN stands in for the ResNet class:
+#: BatchNorm stats are worker-local in async PS, so a normed model's
+#: central eval would misread training; the protocol physics are identical)
+_TTA_IMG_WORKERS = 4
+_TTA_IMG_SERVERS = 2
+_TTA_IMG_BATCH = 64
+_TTA_IMG_STEPS = 80
+_TTA_IMG_LR = 0.3
+_TTA_IMG_NOISE = 0.8
+_TTA_IMG_TARGET_ACC = 0.85
+_TTA_IMG_REPEATS = 3
+#: straggler pauses scaled to the ~25 ms image step (vs the LR jitter):
+#: real-cluster stragglers are ~10x a step, not a fixed 30 ms
+_TTA_IMG_JITTER_P = 0.10
+_TTA_IMG_JITTER_S = 0.25
+_TTA_IMG_RUN_BUDGET_S = 120.0
+
 
 def _tta_one(mode_name: str, mode, max_delay: int, repeat: int) -> dict:
     """One training run to target under one consistency mode.
@@ -1722,6 +1740,165 @@ def _tta_one(mode_name: str, mode, max_delay: int, repeat: int) -> dict:
         van.close()
 
 
+def _tta_img_one(mode_name: str, mode, max_delay: int, repeat: int) -> dict:
+    """One image-classification run to the accuracy target, one mode.
+
+    The dense-plane twin of ``_tta_one``: a norm-free tiny CNN trained
+    async-PS over the Van (``AsyncDenseLearner`` — full-model pull, grad
+    push, server-side SGD), accuracy polled from a separate eval worker's
+    pull of the CURRENT server params.
+    """
+    import threading
+
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+
+    from parameter_server_tpu.config import ConsistencyConfig, OptimizerConfig
+    from parameter_server_tpu.core.postoffice import Postoffice
+    from parameter_server_tpu.core.van import LoopbackVan
+    from parameter_server_tpu.data.synthetic import SyntheticImages
+    from parameter_server_tpu.kv.dense import (
+        DenseKVServer, DenseKVWorker, PytreeCodec,
+    )
+    from parameter_server_tpu.learner.dense import AsyncDenseLearner
+
+    class TinyCNN(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            x = nn.relu(nn.Conv(16, (3, 3), strides=2)(x))
+            x = nn.relu(nn.Conv(32, (3, 3), strides=2)(x))
+            x = x.mean(axis=(1, 2))
+            return nn.Dense(10)(x)
+
+    model = TinyCNN()
+    ev = SyntheticImages(seed=9999, noise=_TTA_IMG_NOISE)
+    ei, el = zip(*[ev.next_batch() for _ in range(4)])
+    eval_imgs = jnp.asarray(np.concatenate(ei))
+    eval_labels = jnp.asarray(np.concatenate(el))
+
+    van = LoopbackVan()
+    try:
+        streams = [
+            SyntheticImages(
+                seed=100 + 17 * repeat + i, noise=_TTA_IMG_NOISE,
+                batch_size=_TTA_IMG_BATCH,
+            )
+            for i in range(_TTA_IMG_WORKERS)
+        ]
+        jrngs = [
+            np.random.default_rng(1000 + 29 * repeat + i)
+            for i in range(_TTA_IMG_WORKERS)
+        ]
+
+        def batch_fn(i):
+            def fn():
+                if jrngs[i].random() < _TTA_IMG_JITTER_P:
+                    time.sleep(_TTA_IMG_JITTER_S)
+                return streams[i].next_batch()
+
+            return fn
+
+        ex = streams[0].next_batch()
+        variables = model.init(
+            jax.random.PRNGKey(0), jnp.asarray(ex[0][:1]), train=False
+        )
+        total = PytreeCodec(variables["params"]).total
+        kws = [
+            DenseKVWorker(
+                Postoffice(f"W{i}", van), {"model": total}, _TTA_IMG_SERVERS
+            )
+            for i in range(_TTA_IMG_WORKERS)
+        ]
+        learner = AsyncDenseLearner(
+            model, kws, ConsistencyConfig(mode=mode, max_delay=max_delay),
+            ex, seed=0,
+        )
+        for s in range(_TTA_IMG_SERVERS):
+            DenseKVServer(
+                Postoffice(f"S{s}", van),
+                {"model": (
+                    total,
+                    OptimizerConfig(kind="sgd", learning_rate=_TTA_IMG_LR),
+                )},
+                s, _TTA_IMG_SERVERS,
+                init_vectors={"model": learner.initial_vector()},
+            )
+        evw = DenseKVWorker(
+            Postoffice("WE", van), {"model": total}, _TTA_IMG_SERVERS
+        )
+
+        @jax.jit
+        def acc_fn(params):
+            out = model.apply({"params": params}, eval_imgs, train=False)
+            return jnp.mean(
+                (jnp.argmax(out, -1) == eval_labels).astype(jnp.float32)
+            )
+
+        curve: list[tuple[float, int, float]] = []
+        done = threading.Event()
+        fail: list[BaseException] = []
+
+        def trainer():
+            try:
+                learner.run(
+                    [batch_fn(i) for i in range(_TTA_IMG_WORKERS)],
+                    _TTA_IMG_STEPS, timeout=120.0,
+                )
+            except BaseException as e:  # noqa: BLE001 — surface to caller
+                fail.append(e)
+            finally:
+                done.set()
+
+        def eval_point():
+            p = learner.codec.unflatten(evw.pull_sync("model", 60))
+            curve.append(
+                (
+                    time.perf_counter() - t0,
+                    len(learner._losses) * _TTA_IMG_BATCH,
+                    float(acc_fn(p)),
+                )
+            )
+
+        th = threading.Thread(target=trainer, name=f"tta-img-{mode_name}")
+        t0 = time.perf_counter()
+        th.start()
+        while not done.is_set():
+            time.sleep(0.25)
+            eval_point()
+        th.join()
+        if fail:
+            raise fail[0]
+        eval_point()  # final model, unconditionally (same rule as _tta_one)
+        wall = time.perf_counter() - t0
+
+        hit_wall = hit_ex = None
+        for j, (t, ex_n, acc) in enumerate(curve):
+            if acc >= _TTA_IMG_TARGET_ACC:
+                if j == 0:
+                    hit_wall, hit_ex = t, ex_n
+                else:
+                    tp, exp_, accp = curve[j - 1]
+                    f = (_TTA_IMG_TARGET_ACC - accp) / max(acc - accp, 1e-9)
+                    hit_wall = tp + f * (t - tp)
+                    hit_ex = int(exp_ + f * (ex_n - exp_))
+                break
+        return {
+            "mode": mode_name,
+            "wall_s": round(wall, 3),
+            "wall_to_target_s": (
+                round(hit_wall, 3) if hit_wall is not None else None
+            ),
+            "examples_to_target": hit_ex,
+            "final_acc": round(curve[-1][2], 4) if curve else None,
+            "curve": [
+                [round(t, 3), ex_n, round(a, 4)] for t, ex_n, a in curve
+            ],
+        }
+    finally:
+        van.close()
+
+
 def run_tta() -> tuple[dict, list[str]]:
     """Time-to-accuracy across the consistency spectrum (VERDICT r4 #2).
 
@@ -1765,6 +1942,40 @@ def run_tta() -> tuple[dict, list[str]]:
             f"examples={med_ex} hits={len(ok)}/{_TTA_REPEATS} "
             f"total-wall={[r['wall_s'] for r in runs]}"
         )
+    # -- part (b): the image half (norm-free CNN over the dense plane) -----
+    img_results: dict[str, dict] = {}
+    for name, mode_attr, tau in _TTA_MODES:
+        mode = getattr(ConsistencyMode, mode_attr)
+        runs = [
+            _tta_img_one(name, mode, tau, r) for r in range(_TTA_IMG_REPEATS)
+        ]
+        walls = [r["wall_to_target_s"] for r in runs]
+        ok = [w for w in walls if w is not None]
+        med_wall = float(np.median(ok)) if ok else None
+        exs = [
+            r["examples_to_target"]
+            for r in runs
+            if r["examples_to_target"] is not None
+        ]
+        img_results[name] = {
+            "tau": tau,
+            "wall_to_target_s": (
+                round(med_wall, 3) if med_wall is not None else None
+            ),
+            "examples_to_target": int(np.median(exs)) if exs else None,
+            "hits": len(ok),
+            "repeats": [
+                {k: v for k, v in r.items() if k != "curve"} for r in runs
+            ],
+            "curve": runs[0]["curve"],
+        }
+        lines.append(
+            f"tta-img {name} (tau={tau}): wall-to-acc{_TTA_IMG_TARGET_ACC} "
+            f"median={img_results[name]['wall_to_target_s']}s "
+            f"hits={len(ok)}/{_TTA_IMG_REPEATS} "
+            f"final_acc={[r['final_acc'] for r in runs]}"
+        )
+
     v = results["ssp2"]["wall_to_target_s"]
     record = {
         "metric": "tta_criteo_lr_ssp2_seconds_to_auc860",
@@ -1782,8 +1993,53 @@ def run_tta() -> tuple[dict, list[str]]:
             "jitter": {"p": _TTA_JITTER_P, "sleep_s": _TTA_JITTER_S},
         },
         "modes": results,
+        "image": {
+            "target_acc": _TTA_IMG_TARGET_ACC,
+            "agg": f"median-of-{_TTA_IMG_REPEATS}",
+            "config": {
+                "model": "norm-free tiny CNN (16/32 conv + dense head)",
+                "workers": _TTA_IMG_WORKERS, "servers": _TTA_IMG_SERVERS,
+                "batch": _TTA_IMG_BATCH,
+                "steps_per_worker": _TTA_IMG_STEPS,
+                "noise": _TTA_IMG_NOISE,
+                "jitter": {
+                    "p": _TTA_IMG_JITTER_P, "sleep_s": _TTA_IMG_JITTER_S,
+                },
+            },
+            "modes": img_results,
+        },
     }
     return record, lines
+
+
+def _tta_img_md(img: dict) -> str:
+    """BASELINE.md block for the image half of the quality clock."""
+    if not img:
+        return ""
+    bsp = img["modes"]["bsp"]["wall_to_target_s"]
+    rows = ""
+    for name, m in img["modes"].items():
+        w = m["wall_to_target_s"]
+        speedup = f"{bsp / w:.2f}x" if (bsp is not None and w) else "—"
+        rows += (
+            f"| {name} | {m['tau']} | {w if w is not None else 'not hit'} | "
+            f"{m['examples_to_target'] or '—'} | {speedup} | "
+            f"{m['hits']}/{img['agg'].split('-')[-1]} |\n"
+        )
+    c = img["config"]
+    return (
+        f"\n**Image half** ({c['model']}, async dense-plane PS — full-model "
+        f"pull / grad push over the Van, {c['workers']}w/{c['servers']}s, "
+        f"stragglers p={c['jitter']['p']} x "
+        f"{c['jitter']['sleep_s'] * 1e3:.0f} ms — ~10x a step, the "
+        "real-cluster ratio), trained to "
+        f"**accuracy {img['target_acc']}** on the synthetic template "
+        "stream; a norm-free model stands in for the ResNet class because "
+        "BatchNorm statistics are worker-local in async PS and would skew "
+        "a central eval:\n\n"
+        "| mode | tau | wall-to-target (s) | examples-to-target | "
+        "speedup vs BSP | hits |\n|---|---|---|---|---|---|\n" + rows
+    )
 
 
 _TTA_BEGIN = "<!-- BENCH-TTA:BEGIN -->"
@@ -1824,6 +2080,7 @@ def record_tta(record: dict) -> None:
         "tau costs little statistical efficiency).  Full eval curves "
         "(wall_s, examples, auc, logloss per point) ride in the bench "
         "JSON for plotting.\n"
+        + _tta_img_md(record.get("image", {}))
     )
     _splice_baseline(
         _TTA_BEGIN,
@@ -2163,7 +2420,11 @@ def main() -> None:
         force_cpu()
         _start_watchdog(
             "tta_criteo_lr_ssp2_seconds_to_auc860", "s",
-            default_s=len(_TTA_MODES) * _TTA_REPEATS * _TTA_RUN_BUDGET_S
+            default_s=len(_TTA_MODES)
+            * (
+                _TTA_REPEATS * _TTA_RUN_BUDGET_S
+                + _TTA_IMG_REPEATS * _TTA_IMG_RUN_BUDGET_S
+            )
             + 300.0,
         )
         try:

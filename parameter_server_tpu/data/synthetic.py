@@ -96,3 +96,39 @@ class SyntheticDLRM:
             np.float32
         )
         return keys, dense, labels
+
+
+@dataclasses.dataclass
+class SyntheticImages:
+    """Learnable image-classification stream (ResNet-class benchmarks).
+
+    Each class owns a fixed random template; a sample is its class template
+    plus gaussian noise — a tiny convnet separates the classes quickly, so
+    time-to-accuracy is measurable without real data (the ResNet half of
+    the north-star quality clock, VERDICT r4 #2 wording).
+    """
+
+    num_classes: int = 10
+    hw: int = 16
+    batch_size: int = 64
+    seed: int = 0
+    noise: float = 1.0
+
+    def __post_init__(self) -> None:
+        self._rng = np.random.default_rng(self.seed)
+        tmpl_rng = np.random.default_rng(0xC1A55)
+        self._templates = tmpl_rng.normal(
+            size=(self.num_classes, self.hw, self.hw, 3)
+        ).astype(np.float32)
+
+    def next_batch(self):
+        rng = self._rng
+        labels = rng.integers(
+            0, self.num_classes, size=self.batch_size
+        ).astype(np.int32)
+        images = (
+            self._templates[labels]
+            + self.noise
+            * rng.normal(size=(self.batch_size, self.hw, self.hw, 3))
+        ).astype(np.float32)
+        return images, labels

@@ -90,11 +90,13 @@ class SpmdDenseTrainer:
 
         def train_step(params, extra, opt_state, images, labels):
             def loss(p):
+                # mutable=[] (norm-free model) still returns (out, {}) —
+                # `or False` would collapse it and break the unpack
                 out, new_extra = model.apply(
                     {"params": p, **extra},
                     images,
                     train=True,
-                    mutable=list(extra.keys()) or False,
+                    mutable=list(extra.keys()),
                 )
                 return self.loss_fn(out, labels), new_extra
 
@@ -185,11 +187,14 @@ class AsyncDenseLearner:
 
         def grad_step(params, extra, images, labels):
             def loss(p):
+                # mutable=[] (norm-free model) still returns (out, {}) —
+                # the old `or False` collapsed that to a bare output and
+                # broke the tuple unpack for models with no collections
                 out, new_extra = model.apply(
                     {"params": p, **extra},
                     images,
                     train=True,
-                    mutable=list(extra.keys()) or False,
+                    mutable=list(extra.keys()),
                 )
                 return self.loss_fn(out, labels), new_extra
 

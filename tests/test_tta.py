@@ -36,3 +36,20 @@ def test_tta_one_hits_target(tiny_tta, name, mode, tau):
     # examples monotone; auc ends above start (it learned)
     assert np.all(np.diff(curve[:, 1]) >= 0)
     assert curve[-1, 2] > curve[0, 2]
+
+
+def test_tta_img_one_hits_target(monkeypatch):
+    """The image half (norm-free CNN over the dense async plane) must
+    produce a well-formed curve and hit a modest target at smoke scale."""
+    monkeypatch.setattr(bench, "_TTA_IMG_STEPS", 40)
+    monkeypatch.setattr(bench, "_TTA_IMG_TARGET_ACC", 0.5)
+    monkeypatch.setattr(bench, "_TTA_IMG_JITTER_P", 0.02)
+    monkeypatch.setattr(bench, "_TTA_IMG_JITTER_S", 0.01)
+    r = bench._tta_img_one("bsp", ConsistencyMode.BSP, 0, repeat=0)
+    assert r["wall_to_target_s"] is not None, r
+    assert r["examples_to_target"] > 0
+    assert r["final_acc"] > 0.5
+    curve = np.asarray(r["curve"])
+    assert curve.shape[1] == 3  # (wall_s, examples, accuracy)
+    assert np.all(np.isfinite(curve))
+    assert curve[-1, 2] > curve[0, 2]  # it learned
