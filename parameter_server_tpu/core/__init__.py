@@ -5,6 +5,7 @@ ICI never touches this layer (XLA collectives move it); these objects carry
 control-plane and DCN-plane traffic.
 """
 
+from parameter_server_tpu.core.chaos import ChaosConfig, ChaosVan
 from parameter_server_tpu.core.messages import (
     Message,
     NodeRole,
@@ -13,15 +14,20 @@ from parameter_server_tpu.core.messages import (
     server_id,
     worker_id,
 )
-from parameter_server_tpu.core.van import LoopbackVan, Van
+from parameter_server_tpu.core.resender import ReliableVan
+from parameter_server_tpu.core.van import LoopbackVan, Van, VanWrapper
 
 __all__ = [
+    "ChaosConfig",
+    "ChaosVan",
     "LoopbackVan",
     "Message",
     "NodeRole",
+    "ReliableVan",
     "Task",
     "TaskKind",
     "Van",
+    "VanWrapper",
     "server_id",
     "worker_id",
 ]

@@ -1,0 +1,299 @@
+"""ChaosVan: seeded, deterministic fault injection for any Van.
+
+The reference tolerated lossy asynchronous networks but never shipped a way
+to *prove* it: ``script/local.sh`` integration runs exercised the happy path
+only (SURVEY.md §4 "opportunity").  This module is the missing harness — a
+Van decorator that injects in-flight faults between ``send`` and delivery:
+
+- **drop**: the message is silently lost (the sender still sees ``True`` —
+  a real network cannot tell you at send time, which is exactly the failure
+  mode the fire-and-forget Van could not express before: ``disconnect`` is
+  rejected-at-send, drop is lost-in-flight);
+- **latency**: fixed delay plus uniform jitter, delivered via a timer wheel
+  so in-order timestamps keep per-link FIFO and jitter breaks it;
+- **duplicate**: the message is delivered twice (what a retransmitting
+  sender looks like from the receiver's side);
+- **reorder**: an extra delay penalty that lets the next message on the
+  link overtake this one;
+- **partition**: per-link blackholes, asymmetric by default (A can reach B
+  while B cannot reach A — the split-brain shape ``disconnect`` cannot
+  model).
+
+Determinism: every decision comes from a per-link ``random.Random`` keyed
+by ``(seed, sender, recver)`` via crc32, and exactly four uniforms are
+drawn per message regardless of config, so a fixed seed plus a fixed
+per-link send order yields the identical fault sequence run over run.
+(Per-link send order is single-threaded everywhere in this codebase —
+submitting threads on the requester side, the endpoint recv thread on the
+responder side — so seeded chaos tests are reproducible; see
+tests/test_chaos.py.)
+
+Pair with :class:`~parameter_server_tpu.core.resender.ReliableVan` *above*
+this wrapper (``ReliableVan(ChaosVan(LoopbackVan()))``) to prove exactly-
+once delivery under loss.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import itertools
+import logging
+import random
+import threading
+import time
+import zlib
+from typing import Callable, Dict, Optional, Tuple
+
+from parameter_server_tpu.core.messages import Message
+from parameter_server_tpu.core.van import Van, VanWrapper
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosConfig:
+    """Per-link fault rates.  All probabilities in [0, 1]; delays in sec."""
+
+    #: P(message silently lost in flight).
+    drop: float = 0.0
+    #: P(message delivered twice).
+    duplicate: float = 0.0
+    #: P(message delayed past its successor on the link).
+    reorder: float = 0.0
+    #: fixed added latency.
+    delay: float = 0.0
+    #: uniform extra latency in [0, jitter).
+    jitter: float = 0.0
+    #: penalty added on a reorder hit (must exceed the link's typical
+    #: inter-send gap to actually swap adjacent messages).
+    reorder_delay: float = 0.01
+
+    @property
+    def inert(self) -> bool:
+        return (
+            self.drop == 0.0
+            and self.duplicate == 0.0
+            and self.reorder == 0.0
+            and self.delay == 0.0
+            and self.jitter == 0.0
+        )
+
+
+class TimerWheel:
+    """Deferred executor: ``schedule(delay, fn)`` runs ``fn`` on one wheel
+    thread at ``now + delay``, ordered by (due time, enqueue order) — equal
+    delays therefore preserve enqueue order (per-link FIFO under fixed
+    latency), while jittered delays reorder, which is the point."""
+
+    def __init__(self, name: str = "chaos-wheel") -> None:
+        self._heap: list = []
+        self._seq = itertools.count()
+        self._cond = threading.Condition()
+        self._stopped = False
+        self._thread = threading.Thread(
+            target=self._run, name=name, daemon=True
+        )
+        self._thread.start()
+
+    def schedule(self, delay: float, fn: Callable[[], None]) -> None:
+        due = time.monotonic() + max(delay, 0.0)
+        with self._cond:
+            if self._stopped:
+                return
+            heapq.heappush(self._heap, (due, next(self._seq), fn))
+            self._cond.notify()
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while not self._stopped:
+                    if not self._heap:
+                        self._cond.wait()
+                        continue
+                    wait = self._heap[0][0] - time.monotonic()
+                    if wait <= 0:
+                        break
+                    self._cond.wait(wait)
+                if self._stopped:
+                    return
+                _due, _n, fn = heapq.heappop(self._heap)
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — a bad delivery must not kill
+                # the only wheel thread (all later delayed messages would
+                # silently never fire)
+                logging.getLogger(__name__).exception(
+                    "chaos: deferred delivery failed"
+                )
+
+    def stop(self) -> None:
+        with self._cond:
+            self._stopped = True
+            self._cond.notify()
+        self._thread.join(timeout=5)
+
+
+class ChaosVan(VanWrapper):
+    """Fault-injecting Van decorator.  See module docstring.
+
+    ``send`` always returns True (unless the van is closed): the chaos
+    layer models a network that *accepted* the frame — whether it arrives
+    is decided in flight.  Inner-van send failures (unbound receiver) are
+    swallowed and counted in ``unreachable_drops``, so a dead node looks
+    like loss, which is what retransmission layers must survive.
+    """
+
+    def __init__(
+        self,
+        inner: Van,
+        *,
+        seed: int = 0,
+        default: Optional[ChaosConfig] = None,
+        links: Optional[Dict[Tuple[str, str], ChaosConfig]] = None,
+        drop: float = 0.0,
+        duplicate: float = 0.0,
+        reorder: float = 0.0,
+        delay: float = 0.0,
+        jitter: float = 0.0,
+    ) -> None:
+        super().__init__(inner)
+        if default is None:
+            default = ChaosConfig(
+                drop=drop, duplicate=duplicate, reorder=reorder,
+                delay=delay, jitter=jitter,
+            )
+        self.seed = seed
+        self.default = default
+        self.links: Dict[Tuple[str, str], ChaosConfig] = dict(links or {})
+        self._rngs: Dict[Tuple[str, str], random.Random] = {}
+        self._partitions: set[Tuple[str, str]] = set()
+        self._lock = threading.Lock()
+        self._wheel: Optional[TimerWheel] = None
+        self._closed = False
+        #: injection counters (asserted by the chaos test suite).
+        self.injected_drops = 0
+        self.injected_dups = 0
+        self.injected_reorders = 0
+        self.partition_drops = 0
+        self.unreachable_drops = 0
+        self.forwarded = 0
+
+    # -- configuration -------------------------------------------------------
+    def set_link(self, sender: str, recver: str, cfg: ChaosConfig) -> None:
+        """Override the fault config for one directed link."""
+        with self._lock:
+            self.links[(sender, recver)] = cfg
+
+    def config_for(self, link: Tuple[str, str]) -> ChaosConfig:
+        with self._lock:
+            return self.links.get(link, self.default)
+
+    # -- partitions (asymmetric per directed link) ---------------------------
+    def partition(self, a: str, b: str, *, symmetric: bool = False) -> None:
+        """Blackhole traffic a -> b (and b -> a when ``symmetric``)."""
+        with self._lock:
+            self._partitions.add((a, b))
+            if symmetric:
+                self._partitions.add((b, a))
+
+    def heal(self, a: Optional[str] = None, b: Optional[str] = None) -> None:
+        """Heal one directed link, or every partition when called bare."""
+        with self._lock:
+            if a is None:
+                self._partitions.clear()
+            else:
+                self._partitions.discard((a, b))
+
+    # -- send path -----------------------------------------------------------
+    def _rng(self, link: Tuple[str, str]) -> random.Random:
+        r = self._rngs.get(link)
+        if r is None:
+            key = zlib.crc32(f"{self.seed}:{link[0]}->{link[1]}".encode())
+            r = self._rngs[link] = random.Random(key)
+        return r
+
+    def send(self, msg: Message) -> bool:
+        if self._closed:
+            return False
+        link = (msg.sender, msg.recver)
+        with self._lock:
+            if link in self._partitions:
+                self.partition_drops += 1
+                return True  # swallowed in flight
+            cfg = self.links.get(link, self.default)
+            if cfg.inert:
+                pass_through = True
+            else:
+                pass_through = False
+                # exactly four draws per message, config-independent, so a
+                # config tweak cannot shift the fault sequence of later sends
+                rng = self._rng(link)
+                u_drop = rng.random()
+                u_dup = rng.random()
+                u_jit = rng.random()
+                u_reord = rng.random()
+        if pass_through:
+            ok = self.inner.send(msg)
+            with self._lock:
+                if ok:
+                    self.forwarded += 1
+                else:
+                    self.unreachable_drops += 1
+            return True
+        if u_drop < cfg.drop:
+            with self._lock:
+                self.injected_drops += 1
+            return True
+        copies = 1
+        if u_dup < cfg.duplicate:
+            copies = 2
+            with self._lock:
+                self.injected_dups += 1
+        latency = cfg.delay + u_jit * cfg.jitter
+        if u_reord < cfg.reorder:
+            latency += cfg.reorder_delay
+            with self._lock:
+                self.injected_reorders += 1
+        if latency <= 0.0:
+            # synchronous path: per-link FIFO preserved exactly (duplicates
+            # arrive back to back, like an eager retransmitter)
+            for _ in range(copies):
+                self._deliver(msg)
+            return True
+        wheel = self._ensure_wheel()
+        for _ in range(copies):
+            wheel.schedule(latency, lambda m=msg: self._deliver(m))
+        return True
+
+    def _deliver(self, msg: Message) -> None:
+        ok = self.inner.send(msg)
+        with self._lock:
+            if ok:
+                self.forwarded += 1
+            else:
+                self.unreachable_drops += 1
+
+    def _ensure_wheel(self) -> TimerWheel:
+        with self._lock:
+            if self._wheel is None:
+                self._wheel = TimerWheel()
+            return self._wheel
+
+    # -- stats / lifecycle ---------------------------------------------------
+    def counters(self) -> dict:
+        with self._lock:
+            return {
+                "chaos_drops": self.injected_drops,
+                "chaos_dups": self.injected_dups,
+                "chaos_reorders": self.injected_reorders,
+                "chaos_partition_drops": self.partition_drops,
+                "chaos_unreachable": self.unreachable_drops,
+            }
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            wheel = self._wheel
+            self._wheel = None
+        if wheel is not None:
+            wheel.stop()
+        self.inner.close()

@@ -241,6 +241,11 @@ class Manager(Customer):
             )
             left = None if deadline is None else max(deadline - time.monotonic(), 0.1)
             ok = self.wait(ts, timeout=left)
+            if not ok:
+                # deadline while the scheduler is unreachable: finalize the
+                # task so _pending/_responses don't leak one entry per
+                # timed-out barrier round
+                self.cancel(ts, "barrier poll deadline")
             responses = self.take_responses(ts)
             if not ok or not responses:
                 return False

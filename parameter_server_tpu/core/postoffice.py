@@ -20,6 +20,7 @@ from typing import Callable, Optional
 
 from parameter_server_tpu.core.messages import Message, TimestampGenerator
 from parameter_server_tpu.core.van import Van
+from parameter_server_tpu.utils.threads import CALLBACKS
 
 
 class Postoffice:
@@ -43,7 +44,31 @@ class Postoffice:
     def _on_recv(self, msg: Message) -> None:
         customer = self._customers.get(msg.task.customer)
         if customer is None:
-            return  # unknown customer: drop (matches reference glog-and-drop)
+            # The reference glog-and-dropped here, which leaves the
+            # requester's wait(ts) hanging forever.  Answer requests with an
+            # __error__ payload instead so the task completes with a
+            # reportable error; responses for unknown customers stay dropped
+            # (replying to a response would ping-pong between two confused
+            # nodes).
+            if msg.is_request:
+                logging.getLogger(__name__).warning(
+                    "%s: request for unknown customer %r from %s",
+                    self.node_id,
+                    msg.task.customer,
+                    msg.sender,
+                )
+                reply = msg.reply()
+                reply.task = dataclasses.replace(
+                    msg.task,
+                    payload={
+                        "__error__": (
+                            f"unknown customer {msg.task.customer!r} "
+                            f"on {self.node_id}"
+                        )
+                    },
+                )
+                self.van.send(reply)
+            return
         if msg.is_request:
             try:
                 reply = customer.process_request(msg)
@@ -85,6 +110,7 @@ class Customer:
         self._callbacks: dict[int, Callable[[list[Message]], None]] = {}
         self._responses: dict[int, list[Message]] = {}
         self._errors: dict[int, list[str]] = {}
+        self._responded: dict[int, set[str]] = {}  # senders already counted
         self._kept: set[int] = set()  # timestamps whose responses are retained
         self._executed: dict[str, int] = {}  # per-sender executed task time
         self._cond = threading.Condition()
@@ -151,6 +177,36 @@ class Customer:
         with self._cond:
             return self._cond.wait_for(lambda: ts not in self._pending, timeout)
 
+    def wait_deadline(self, ts: int, deadline: Optional[float]) -> bool:
+        """Like :meth:`wait` against an absolute ``time.monotonic`` deadline
+        (callers waiting on several tasks share one budget instead of
+        resetting the clock per task)."""
+        import time as _time
+
+        timeout = None if deadline is None else deadline - _time.monotonic()
+        if timeout is not None and timeout <= 0:
+            return self.done(ts)
+        return self.wait(ts, timeout)
+
+    def cancel(self, ts: int, reason: str = "cancelled") -> bool:
+        """Finalize a still-pending task ``ts`` with an error.
+
+        A timed-out :meth:`wait` used to leave the task pending forever —
+        ``_pending``/``_responses``/``_errors`` state leaked, and a late
+        response could complete a task the caller had already abandoned.
+        ``cancel`` closes that hole: the task finishes NOW with ``reason``
+        recorded as an error (``errors(ts)``/``check(ts)`` report it for
+        kept tasks), late responses are ignored by the existing
+        duplicate-response guard, and all bookkeeping is freed by the normal
+        completion path.  Returns False if ``ts`` already completed.
+        """
+        with self._cond:
+            if ts not in self._pending:
+                return False
+            self._errors.setdefault(ts, []).append(reason)
+            self._finish_locked(ts)
+            return True
+
     def done(self, ts: int) -> bool:
         with self._cond:
             return ts not in self._pending
@@ -178,6 +234,13 @@ class Customer:
         with self._cond:
             if ts not in self._pending:
                 return  # late/duplicate response
+            responded = self._responded.setdefault(ts, set())
+            if msg.sender in responded:
+                # duplicate leg (an app-layer retry racing its original):
+                # counting it would complete the task with another
+                # receiver's response missing
+                return
+            responded.add(msg.sender)
             if err is not None:
                 self._errors.setdefault(ts, []).append(f"{msg.sender}: {err}")
             if ts in self._responses:
@@ -199,6 +262,7 @@ class Customer:
 
     def _finish_locked(self, ts: int) -> None:
         del self._pending[ts]
+        self._responded.pop(ts, None)
         cb = self._callbacks.pop(ts, None)
         if ts in self._kept:
             responses = self._responses.get(ts, [])
@@ -209,10 +273,10 @@ class Customer:
             self._errors.pop(ts, None)
         self._cond.notify_all()
         if cb is not None:
-            # Fire outside the lock to allow callbacks to re-submit.
-            threading.Thread(
-                target=cb, args=(responses,), daemon=True
-            ).start()
+            # Fire off-thread (callbacks may re-submit) on the shared daemon
+            # pool — thread-per-callback was unbounded thread creation under
+            # high async push rates.
+            CALLBACKS.submit(cb, responses)
 
     # -- responder side -----------------------------------------------------
     def process_request(self, msg: Message) -> Optional[Message]:

@@ -1,0 +1,316 @@
+"""ReliableVan: ACK / retransmit / dedup on top of any Van.
+
+Reference analogue: ``src/system/resender.h`` [U] — the layer the reference
+kept between the Van's ZeroMQ sockets and the Postoffice so that a message
+lost *in flight* (not rejected at send time) is retransmitted until acked,
+and a retransmission that races its own ack is deduplicated at the receiver
+instead of double-applying a gradient push.
+
+Protocol, per directed link ``(sender, recver)``:
+
+- every outbound message is stamped with a monotonically increasing
+  sequence number (``task.payload["__rseq__"]`` — payload-borne so it
+  survives the TcpVan's pickle header unchanged);
+- the receiving ReliableVan immediately answers with a tiny ACK control
+  frame (customer ``__resender__``, never delivered to the Postoffice),
+  then checks the seq against a per-link seen-window: fresh messages are
+  delivered with the stamp stripped, repeats are counted in
+  ``dup_suppressed`` and swallowed — retried pushes are idempotent;
+- unacked sends are retransmitted by a single timer thread with
+  exponential backoff plus seeded jitter, up to ``max_retries``; exhausting
+  the budget drops the message (``gave_up``) and fires ``on_give_up`` so a
+  higher layer can fail the task instead of hanging.
+
+Send-time failures (``inner.send`` returning False: receiver unbound on a
+LoopbackVan, no route on a TcpVan) stay fail-fast — the transport can
+already *name* the receiver as absent, and ``Customer.submit`` turns that
+into an immediate undeliverable error.  Retransmits, by contrast, keep
+trying through send-time failures for the rest of their budget: a dead
+server's identity can come back mid-retry via hot-standby promotion
+(:func:`parameter_server_tpu.kv.replica.promote`), and the retransmit then
+lands on the promoted replica.  Under a :class:`~parameter_server_tpu.core.
+chaos.ChaosVan` (which accepts every frame and loses it in flight) every
+loss is handled by retransmission — the stack to prove reliability is
+``ReliableVan(ChaosVan(LoopbackVan()))``.
+
+Dedup state is keyed by link, not by endpoint object, so a promoted standby
+binding the dead primary's node id inherits the link's seq/window history
+(same Van instance in-process); on a cross-process TcpVan promotion is a
+route update and each process keeps its own windows.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import random
+import threading
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+from parameter_server_tpu.core.messages import Message, Task, TaskKind
+from parameter_server_tpu.core.van import Van, VanWrapper
+
+#: payload key carrying the per-link sequence stamp.
+SEQ_KEY = "__rseq__"
+#: payload key carrying the acked sequence number in ACK frames.
+ACK_KEY = "__rack__"
+#: customer name of ACK frames; intercepted below the Postoffice.
+ACK_CUSTOMER = "__resender__"
+
+_log = logging.getLogger(__name__)
+
+
+class _SeenWindow:
+    """Per-link receiver dedup: contiguous low-watermark + sparse set.
+
+    ``fresh(seq)`` is True exactly once per seq.  Memory is bounded at
+    ``size`` outstanding out-of-order seqs; past that the watermark jumps
+    forward and anything below it reads as a duplicate (safe: the sender's
+    retry budget is far smaller than any sane window).
+    """
+
+    __slots__ = ("size", "low", "seen")
+
+    def __init__(self, size: int) -> None:
+        self.size = size
+        self.low = -1  # every seq <= low has been delivered
+        self.seen: set[int] = set()
+
+    def fresh(self, seq: int) -> bool:
+        if seq <= self.low or seq in self.seen:
+            return False
+        self.seen.add(seq)
+        while self.low + 1 in self.seen:
+            self.low += 1
+            self.seen.discard(self.low)
+        if len(self.seen) > self.size:
+            self.low = min(self.seen)
+            self.seen = {s for s in self.seen if s > self.low}
+        return True
+
+
+@dataclasses.dataclass
+class _Pending:
+    msg: Message  # the stamped copy, resent verbatim
+    link: Tuple[str, str]
+    seq: int
+    attempts: int = 0
+    due: float = 0.0
+
+
+class ReliableVan(VanWrapper):
+    """Reliable-delivery Van decorator (see module docstring).
+
+    ``timeout`` is the first retransmit deadline; attempt ``n`` waits
+    ``timeout * backoff**n`` plus uniform seeded jitter of up to
+    ``jitter`` of that value.  Defaults suit in-process tests (ms RTTs);
+    DCN deployments should scale ``timeout`` to their RTT.
+    """
+
+    def __init__(
+        self,
+        inner: Van,
+        *,
+        timeout: float = 0.25,
+        backoff: float = 2.0,
+        jitter: float = 0.25,
+        max_retries: int = 10,
+        window: int = 4096,
+        seed: int = 0,
+        on_give_up: Optional[Callable[[Message], None]] = None,
+    ) -> None:
+        super().__init__(inner)
+        self.timeout = timeout
+        self.backoff = backoff
+        self.jitter = jitter
+        self.max_retries = max_retries
+        self.window = window
+        self.on_give_up = on_give_up
+        self._rng = random.Random(seed)
+        self._next_seq: Dict[Tuple[str, str], int] = {}
+        self._pending: Dict[Tuple[Tuple[str, str], int], _Pending] = {}
+        self._windows: Dict[Tuple[str, str], _SeenWindow] = {}
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._closed = False
+        #: dashboard counters (metrics.transport_counters merges them).
+        self.retransmits = 0
+        self.dup_suppressed = 0
+        self.gave_up = 0
+        self.acks_sent = 0
+        self.acks_received = 0
+        self._thread = threading.Thread(
+            target=self._retransmit_loop, name="resender-retx", daemon=True
+        )
+        self._thread.start()
+
+    # -- receive side --------------------------------------------------------
+    def bind(self, node_id: str, handler: Callable[[Message], None]) -> None:
+        self.inner.bind(node_id, self._wrap_handler(handler))
+
+    def _wrap_handler(
+        self, handler: Callable[[Message], None]
+    ) -> Callable[[Message], None]:
+        def wrapped(msg: Message) -> None:
+            if msg.task.customer == ACK_CUSTOMER:
+                self._on_ack(msg)
+                return
+            seq = msg.task.payload.get(SEQ_KEY)
+            if seq is None:
+                handler(msg)  # unstamped (foreign/legacy) traffic
+                return
+            link = (msg.sender, msg.recver)
+            # ACK before processing: the sender's clock starts at *its* send
+            self._send_ack(msg, seq)
+            with self._lock:
+                win = self._windows.get(link)
+                if win is None:
+                    win = self._windows[link] = _SeenWindow(self.window)
+                is_fresh = win.fresh(seq)
+                if not is_fresh:
+                    self.dup_suppressed += 1
+            if not is_fresh:
+                return
+            # strip the stamp: replies share this Task's payload dict, and a
+            # stale inherited seq would corrupt the reply link's dedup
+            clean = dataclasses.replace(
+                msg,
+                task=dataclasses.replace(
+                    msg.task,
+                    payload={
+                        k: v
+                        for k, v in msg.task.payload.items()
+                        if k != SEQ_KEY
+                    },
+                ),
+            )
+            handler(clean)
+
+        return wrapped
+
+    def _send_ack(self, msg: Message, seq: int) -> None:
+        ack = Message(
+            task=Task(
+                TaskKind.CONTROL, ACK_CUSTOMER, payload={ACK_KEY: seq}
+            ),
+            sender=msg.recver,
+            recver=msg.sender,
+            is_request=False,
+        )
+        # ACKs are not themselves acked/stamped (that way lies recursion);
+        # a lost ACK is repaired by the peer's retransmit -> dedup -> re-ACK
+        self.inner.send(ack)
+        with self._lock:
+            self.acks_sent += 1
+
+    def _on_ack(self, msg: Message) -> None:
+        # ack for link (our node, peer): msg travelled peer -> us
+        link = (msg.recver, msg.sender)
+        seq = msg.task.payload.get(ACK_KEY)
+        with self._lock:
+            self.acks_received += 1
+            self._pending.pop((link, seq), None)
+
+    # -- send side -----------------------------------------------------------
+    def send(self, msg: Message) -> bool:
+        if self._closed:
+            return False
+        link = (msg.sender, msg.recver)
+        with self._lock:
+            seq = self._next_seq.get(link, 0)
+            self._next_seq[link] = seq + 1
+        stamped = dataclasses.replace(
+            msg,
+            task=dataclasses.replace(
+                msg.task, payload={**msg.task.payload, SEQ_KEY: seq}
+            ),
+        )
+        if not self.inner.send(stamped):
+            return False  # fail-fast: see module docstring
+        with self._wake:
+            self._pending[(link, seq)] = _Pending(
+                stamped, link, seq, attempts=0,
+                due=time.monotonic() + self._deadline(0),
+            )
+            self._wake.notify()
+        return True
+
+    def _deadline(self, attempt: int) -> float:
+        base = self.timeout * (self.backoff ** attempt)
+        return base * (1.0 + self.jitter * self._rng.random())
+
+    def _retransmit_loop(self) -> None:
+        while True:
+            resend: list[_Pending] = []
+            dead: list[_Pending] = []
+            with self._wake:
+                if self._closed:
+                    return
+                now = time.monotonic()
+                nxt: Optional[float] = None
+                for key, p in list(self._pending.items()):
+                    if p.due > now:
+                        nxt = p.due if nxt is None else min(nxt, p.due)
+                        continue
+                    p.attempts += 1
+                    if p.attempts > self.max_retries:
+                        del self._pending[key]
+                        self.gave_up += 1
+                        dead.append(p)
+                    else:
+                        p.due = now + self._deadline(p.attempts)
+                        nxt = p.due if nxt is None else min(nxt, p.due)
+                        resend.append(p)
+                        self.retransmits += 1
+                if not resend and not dead:
+                    self._wake.wait(
+                        timeout=(nxt - now) if nxt is not None else 0.2
+                    )
+                    continue
+            for p in resend:
+                # send-time failure here is NOT fatal: the identity may be
+                # rebound (promotion) before the budget runs out
+                self.inner.send(p.msg)
+            for p in dead:
+                _log.warning(
+                    "resender: gave up on %s->%s seq=%s after %d attempts",
+                    p.link[0], p.link[1], p.seq, p.attempts - 1,
+                )
+                if self.on_give_up is not None:
+                    try:
+                        self.on_give_up(p.msg)
+                    except Exception:  # noqa: BLE001 — user hook
+                        _log.exception("resender: on_give_up hook failed")
+
+    # -- introspection / lifecycle -------------------------------------------
+    def inflight(self) -> int:
+        """Number of sends still awaiting an ACK."""
+        with self._lock:
+            return len(self._pending)
+
+    def flush(self, timeout: float = 5.0) -> bool:
+        """Block until every send is acked (or gave up).  False on timeout."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.inflight() == 0:
+                return True
+            time.sleep(0.005)
+        return self.inflight() == 0
+
+    def counters(self) -> dict:
+        with self._lock:
+            return {
+                "retransmits": self.retransmits,
+                "dup_suppressed": self.dup_suppressed,
+                "gave_up": self.gave_up,
+                "acks_sent": self.acks_sent,
+                "acks_received": self.acks_received,
+            }
+
+    def close(self) -> None:
+        with self._wake:
+            self._closed = True
+            self._wake.notify()
+        self._thread.join(timeout=5)
+        self.inner.close()

@@ -385,6 +385,15 @@ class TcpVan(Van):
                 ep.inbox.put(msg)  # handler runs on the endpoint's own thread
 
     # -- stats / lifecycle ---------------------------------------------------
+    def counters(self) -> dict:
+        with self._lock:
+            return {
+                "sent": self.sent_messages,
+                "dropped": self.dropped_messages,
+                "bytes_sent": self.bytes_sent(),
+                "bytes_recv": self.bytes_recv(),
+            }
+
     def bytes_sent(self) -> int:
         van = self._van
         return int(self._lib.ps_van_bytes_sent(van)) if van else 0

@@ -47,6 +47,41 @@ class Van:
     def close(self) -> None:
         pass
 
+    def counters(self) -> dict:
+        """Dashboard counters (merged across a wrapper stack by
+        ``utils.metrics.transport_counters``)."""
+        return {}
+
+
+class VanWrapper(Van):
+    """Base for decorator Vans (reliability, chaos).
+
+    Delegates the Van interface to ``inner`` explicitly and everything else
+    (``disconnect``/``reconnect``/``add_route``/``address``/...) through
+    ``__getattr__``, so a stack like ``ReliableVan(ChaosVan(LoopbackVan()))``
+    is a drop-in Van for the Postoffice, the Manager's route learning, and
+    the fault-injection helpers alike.
+    """
+
+    def __init__(self, inner: Van) -> None:
+        self.inner = inner
+
+    def bind(self, node_id: str, handler: Callable[[Message], None]) -> None:
+        self.inner.bind(node_id, handler)
+
+    def send(self, msg: Message) -> bool:
+        return self.inner.send(msg)
+
+    def unbind(self, node_id: str) -> None:
+        self.inner.unbind(node_id)
+
+    def close(self) -> None:
+        self.inner.close()
+
+    def __getattr__(self, name):
+        # only reached for attributes not defined on the wrapper itself
+        return getattr(self.inner, name)
+
 
 class _Endpoint:
     """A bound node: its inbox queue and receive thread."""
@@ -151,6 +186,13 @@ class LoopbackVan(Van):
             ep = self._endpoints.pop(node_id, None)
         if ep is not None:
             ep.stop()
+
+    def counters(self) -> dict:
+        with self._lock:
+            return {
+                "sent": self.sent_messages,
+                "dropped": self.dropped_messages,
+            }
 
     def close(self) -> None:
         with self._lock:

@@ -49,6 +49,7 @@ class KVServer(Customer):
         replica: Optional[str] = None,
         replica_sync: bool = False,
         max_replica_lag: int = 8,
+        replica_ack_timeout: float = 60.0,
     ) -> None:
         """``replica``: node id of a hot-standby KVServer holding the same
         shard (chain replication of key ranges, the reference paper's §4.3
@@ -89,6 +90,7 @@ class KVServer(Customer):
         self.replica = replica
         self.replica_sync = replica_sync
         self.max_replica_lag = max_replica_lag
+        self.replica_ack_timeout = replica_ack_timeout
         self._fwd_inflight: collections.deque[int] = collections.deque()
         if replica is not None:
             # A DEDICATED endpoint for the primary's client role: waiting
@@ -108,7 +110,10 @@ class KVServer(Customer):
         )
         ts = self._fwd.submit([fwd])
         if self.replica_sync:
-            if not self._fwd.wait(ts, timeout=60.0):
+            if not self._fwd.wait(ts, timeout=self.replica_ack_timeout):
+                # deadline: free the stuck task before failing the push —
+                # the fwd customer must not leak _pending state per timeout
+                self._fwd.cancel(ts, "replica ack deadline")
                 raise RuntimeError(
                     f"replica {self.replica} did not ack push (sync chain)"
                 )
@@ -117,7 +122,8 @@ class KVServer(Customer):
             self._fwd_inflight.append(ts)
             while len(self._fwd_inflight) > self.max_replica_lag:
                 old = self._fwd_inflight.popleft()
-                if not self._fwd.wait(old, timeout=60.0):
+                if not self._fwd.wait(old, timeout=self.replica_ack_timeout):
+                    self._fwd.cancel(old, "replica ack deadline")
                     raise RuntimeError(
                         f"replica {self.replica} lag exceeded "
                         f"{self.max_replica_lag} and oldest ack timed out"
@@ -128,6 +134,7 @@ class KVServer(Customer):
         while self._fwd_inflight:
             old = self._fwd_inflight.popleft()
             if not self._fwd.wait(old, timeout):
+                self._fwd.cancel(old, "replica flush deadline")
                 raise RuntimeError(f"replica flush: ts={old} not acked")
 
     def handle_request(self, msg: Message) -> Message:

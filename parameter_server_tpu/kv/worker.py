@@ -51,13 +51,21 @@ class KVWorker(Customer):
         localizers: Optional[Dict[str, HashLocalizer]] = None,
         min_bucket: int = 256,
         tracer: Tracer = NULL_TRACER,
+        retry_on_timeout: bool = True,
     ) -> None:
+        """``retry_on_timeout``: when a pull's deadline expires (dead or
+        mid-promotion server), cancel the stuck task and re-issue it ONCE
+        against the same server identity — by then
+        :class:`~parameter_server_tpu.kv.replica.ReplicaSet` has typically
+        rebound ``S{i}`` to the promoted standby, so the retry lands on live
+        state and training continues without surfacing the death."""
         super().__init__(name, post)
         #: host-side span recorder (Push/Pull latency histograms, SURVEY §5)
         self.tracer = tracer
         self.table_cfgs = table_cfgs
         self.num_servers = num_servers
         self.min_bucket = min_bucket
+        self.retry_on_timeout = retry_on_timeout
         self.partitions = {
             t: RangePartition(cfg.rows, num_servers) for t, cfg in table_cfgs.items()
         }
@@ -66,6 +74,9 @@ class KVWorker(Customer):
         }
         #: per-timestamp reassembly info for pulls
         self._pull_plans: Dict[int, dict] = {}
+        #: deadline-retry counters (surfaced next to transport counters)
+        self.pull_retries = 0
+        self.push_retries = 0
 
     # -- push ---------------------------------------------------------------
     def push(self, table: str, keys: np.ndarray, values: np.ndarray) -> int:
@@ -141,6 +152,9 @@ class KVWorker(Customer):
         slots, inverse, _n = localize_to_slots(
             keys, self.localizers[table], min_bucket=self.min_bucket
         )
+        return self._submit_pull(table, slots, inverse, keys.shape)
+
+    def _submit_pull(self, table, slots, inverse, shape) -> int:
         msgs = []
         order = {}
         for s, seg, local in self.partitions[table].slice_ids(slots):
@@ -157,19 +171,31 @@ class KVWorker(Customer):
             "order": order,
             "inverse": inverse,
             "n_slots": slots.shape[0],
-            "shape": keys.shape,
+            "shape": shape,
             "table": table,
+            # retained so a deadline retry can re-issue the identical pull
+            "slots": slots,
         }
         return ts
 
-    def pull_result(self, ts: int, timeout: Optional[float] = None) -> np.ndarray:
-        """Block for pull ``ts`` and reassemble per-position weight rows.
+    def _await_pull(self, ts: int, timeout: Optional[float]) -> tuple:
+        """Wait for pull ``ts``; on deadline, cancel the stuck task and
+        retry ONCE against the (possibly promoted) server identity.
 
-        Output shape: ``keys.shape + (dim,)`` for dim>1 tables, ``keys.shape``
-        for dim=1.
+        Returns ``(ts, plan, responses)`` with all kept state drained.
         """
         with self.tracer.span("kv.pull.wait", ts=ts):
             completed = self.wait(ts, timeout)
+        if not completed and self.retry_on_timeout:
+            plan = self._pull_plans.pop(ts)
+            self.cancel(ts, "pull deadline")  # frees _pending; late/retx
+            self.take_responses(ts)  # responses of the dead task: drained
+            self.pull_retries += 1
+            ts = self._submit_pull(
+                plan["table"], plan["slots"], plan["inverse"], plan["shape"]
+            )
+            with self.tracer.span("kv.pull.wait", ts=ts, retry=1):
+                completed = self.wait(ts, timeout)
         plan = self._pull_plans.pop(ts)  # always reclaim, even on error paths
         errs = self.errors(ts)
         responses = self.take_responses(ts)  # always drain kept state
@@ -182,6 +208,15 @@ class KVWorker(Customer):
                 f"pull ts={ts} incomplete: {len(responses)}/"
                 f"{len(plan['order'])} servers answered (dead server?)"
             )
+        return ts, plan, responses
+
+    def pull_result(self, ts: int, timeout: Optional[float] = None) -> np.ndarray:
+        """Block for pull ``ts`` and reassemble per-position weight rows.
+
+        Output shape: ``keys.shape + (dim,)`` for dim>1 tables, ``keys.shape``
+        for dim=1.
+        """
+        ts, plan, responses = self._await_pull(ts, timeout)
         cfg = self.table_cfgs[plan["table"]]
         uniq_rows = np.zeros((plan["n_slots"], cfg.dim), dtype=cfg.dtype)
         for resp in responses:
@@ -203,20 +238,7 @@ class KVWorker(Customer):
         import jax
         import jax.numpy as jnp
 
-        with self.tracer.span("kv.pull.wait", ts=ts):
-            completed = self.wait(ts, timeout)
-        plan = self._pull_plans.pop(ts)
-        errs = self.errors(ts)
-        responses = self.take_responses(ts)
-        if not completed:
-            raise TimeoutError(f"pull ts={ts} timed out")
-        if errs:
-            raise RuntimeError(f"pull ts={ts} failed on: " + "; ".join(errs))
-        if len(responses) < len(plan["order"]):
-            raise RuntimeError(
-                f"pull ts={ts} incomplete: {len(responses)}/"
-                f"{len(plan['order'])} servers answered (dead server?)"
-            )
+        ts, plan, responses = self._await_pull(ts, timeout)
         cfg = self.table_cfgs[plan["table"]]
         uniq = jnp.zeros((plan["n_slots"], cfg.dim), jnp.dtype(cfg.dtype))
         for resp in responses:
@@ -232,6 +254,40 @@ class KVWorker(Customer):
         self, table: str, keys: np.ndarray, timeout: Optional[float] = None
     ) -> np.ndarray:
         return self.pull_result(self.pull(table, keys), timeout)
+
+    def push_sync(
+        self,
+        table: str,
+        keys: np.ndarray,
+        values: np.ndarray,
+        timeout: Optional[float] = None,
+    ) -> int:
+        """Push and block for all server acks, retrying once on deadline.
+
+        The deadline path mirrors :meth:`pull_result`: the stuck task is
+        cancelled (no leaked ``_pending`` state) and the push re-issued
+        against the same ``S{i}`` identities — live again after a
+        :class:`~parameter_server_tpu.kv.replica.ReplicaSet` promotion.
+        Retried pushes are deduplicated by the transport only when the SAME
+        message is retransmitted (``ReliableVan``); an app-layer retry is a
+        new message, so — like the reference's retry — it can double-apply
+        iff the original was applied but its ack was lost AND the transport
+        below is unreliable.  Run over ``ReliableVan`` (acks retransmitted)
+        that window closes: a surviving server acks, only a dead one
+        triggers the retry.  Returns the completing timestamp.
+        """
+        ts = self.push(table, keys, values)
+        if self.wait(ts, timeout):
+            return ts
+        if not self.retry_on_timeout:
+            raise TimeoutError(f"push ts={ts} timed out")
+        self.cancel(ts, "push deadline")
+        self.push_retries += 1
+        ts = self.push(table, keys, values)
+        if not self.wait(ts, timeout):
+            self.cancel(ts, "push deadline (retry)")
+            raise TimeoutError(f"push ts={ts} timed out after retry")
+        return ts
 
     # -- checkpoint (reference SaveModel/LoadModel broadcast tasks) ----------
     def save_model(

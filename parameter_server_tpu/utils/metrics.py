@@ -42,6 +42,31 @@ def auc(labels: np.ndarray, scores: np.ndarray) -> float:
     return float((ranks[pos].sum() - n_pos * (n_pos + 1) / 2.0) / (n_pos * n_neg))
 
 
+def transport_counters(van) -> dict:
+    """Merge dashboard counters from a (possibly wrapped) Van stack.
+
+    Walks the ``.inner`` chain of Van decorators (``ReliableVan``,
+    ``ChaosVan``) down to the base transport, merging each layer's
+    ``counters()`` dict — so retransmit / dup-suppressed / gave-up /
+    injected-fault counts ride next to sent/dropped in one flat dict.
+    Same-named keys across layers are summed.
+    """
+    out: dict = {}
+    seen = set()
+    v = van
+    while v is not None and id(v) not in seen:
+        seen.add(id(v))
+        get = getattr(v, "counters", None)
+        if callable(get):
+            try:
+                for k, val in get().items():
+                    out[k] = out.get(k, 0) + val
+            except Exception:  # pragma: no cover — metrics must never crash
+                pass
+        v = getattr(v, "inner", None)
+    return out
+
+
 def _auto_peak_flops() -> float:
     """Peak dense FLOP/s of the active backend for the MFU denominator.
 
@@ -138,6 +163,10 @@ class Dashboard:
     peak_flops: float = 0.0
     #: optional span recorder feeding host/H2D/device attribution.
     tracer: Optional[object] = None
+    #: optional Van (stacked wrappers fine): rows gain a ``net`` dict of
+    #: cumulative transport counters — retransmits, dup_suppressed, gave_up,
+    #: injected chaos faults, sent/dropped (see :func:`transport_counters`).
+    transport: Optional[object] = None
     _start: float = dataclasses.field(default_factory=time.time)
     _last_obj: Optional[float] = None
     _last_t: Optional[float] = None
@@ -177,6 +206,10 @@ class Dashboard:
             row["mfu_pct"] = round(mfu * 100.0, 4)
         if extra:
             row.update(extra)
+        if self.transport is not None:
+            net = transport_counters(self.transport)
+            if net:
+                row["net"] = net
         printing = self.print_every and iteration % self.print_every == 0
         if self.tracer is not None and (printing or self.jsonl is not None):
             # interval DELTAS (this row's share), from the tracer's O(1)

@@ -8,8 +8,59 @@ BCD learners alike.
 
 from __future__ import annotations
 
+import logging
+import queue
 import threading
 from typing import Callable, List, Optional, Sequence
+
+
+class CallbackExecutor:
+    """Small shared pool of daemon threads for completion callbacks.
+
+    ``Customer._finish_locked`` used to spawn one thread per callback;
+    under high async push rates that is unbounded thread creation — a
+    robustness hazard in its own right.  This executor caps the fan-out at
+    ``workers`` lazily-started daemon threads feeding off one queue.
+
+    Callbacks must not block indefinitely on OTHER callbacks (task
+    completion itself is driven by Van recv threads, not this pool, so
+    waiting on a task inside a callback is safe — waiting on another
+    *callback* is not).
+    """
+
+    def __init__(self, workers: int = 4, name: str = "ps-callback") -> None:
+        self._q: "queue.SimpleQueue" = queue.SimpleQueue()
+        #: pool cap (public: tests assert the fan-out stays bounded by it).
+        self.workers = workers
+        self._name = name
+        self._lock = threading.Lock()
+        self._started = 0
+
+    def submit(self, fn: Callable, *args) -> None:
+        self._q.put((fn, args))
+        with self._lock:
+            if self._started < self.workers:
+                i = self._started
+                self._started += 1
+                threading.Thread(
+                    target=self._run, name=f"{self._name}-{i}", daemon=True
+                ).start()
+
+    def _run(self) -> None:
+        while True:
+            fn, args = self._q.get()
+            try:
+                fn(*args)
+            except Exception:  # noqa: BLE001 — a bad callback must not kill
+                # a shared pool thread
+                logging.getLogger(__name__).exception(
+                    "callback executor: callback raised"
+                )
+
+
+#: process-wide executor shared by every Customer (the "single shared
+#: daemon executor" replacing thread-per-callback spawns).
+CALLBACKS = CallbackExecutor()
 
 
 class ErrorGroup:

@@ -1,0 +1,539 @@
+"""Reliable delivery under seeded chaos (core/resender.py + core/chaos.py).
+
+The stack under test is ``ReliableVan(ChaosVan(LoopbackVan()))``: the chaos
+layer loses/duplicates/delays messages *in flight* with a seeded RNG, and
+the resender's ACK/retransmit/dedup protocol must make delivery exactly-
+once anyway — pushes never lost, never double-applied, training loss equal
+to a clean run.  Every test here is deterministic given its seed (per-link
+RNGs, single-threaded per-link send order); ``test_seed_determinism``
+asserts that reproducibility directly.
+
+Determinism ground rules for counter-equality assertions: latency must be 0
+(jittered delivery can outrun the retransmit deadline and inject extra,
+timing-dependent duplicates) and the resender timeout must dwarf the
+in-process RTT (so no spurious retransmits consume extra RNG draws).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from parameter_server_tpu.config import OptimizerConfig, TableConfig
+from parameter_server_tpu.core.chaos import ChaosConfig, ChaosVan
+from parameter_server_tpu.core.messages import Message, Task, TaskKind
+from parameter_server_tpu.core.postoffice import Customer, Postoffice
+from parameter_server_tpu.core.resender import ReliableVan
+from parameter_server_tpu.core.van import LoopbackVan
+from parameter_server_tpu.data.synthetic import SyntheticCTR
+from parameter_server_tpu.kv import replica as replica_lib
+from parameter_server_tpu.kv.server import KVServer
+from parameter_server_tpu.kv.worker import KVWorker
+from parameter_server_tpu.models import linear
+from parameter_server_tpu.utils.metrics import transport_counters
+
+pytestmark = pytest.mark.chaos
+
+ROWS = 1 << 10
+NUM_SERVERS = 2
+STEPS = 12
+
+
+class Echo(Customer):
+    def handle_request(self, msg):
+        return msg.reply(values=[v * 2 for v in msg.values])
+
+
+def _reliable_stack(
+    *, seed=0, timeout=0.05, backoff=1.0, max_retries=60, **chaos_kw
+):
+    """ReliableVan(ChaosVan(LoopbackVan())) tuned for in-process tests.
+
+    Flat backoff: with exponential backoff an unlucky retransmit chain's
+    cumulative deadline explodes past any sane wait(); at in-process RTTs a
+    flat short deadline with a deep budget converges orders of magnitude
+    faster and keeps give-up probability negligible.
+    """
+    chaos = ChaosVan(LoopbackVan(), seed=seed, **chaos_kw)
+    van = ReliableVan(
+        chaos, timeout=timeout, backoff=backoff, max_retries=max_retries,
+        seed=seed,
+    )
+    return van, chaos
+
+
+def _settle(predicate, deadline_s=5.0):
+    """Poll until ``predicate()`` (quiescence helper: ACKs/dups ride recv
+    threads, so counters lag the last wait() by a scheduler tick)."""
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.01)
+    return predicate()
+
+
+# --------------------------------------------------------------- unit level
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_rpc_survives_heavy_drop(seed):
+    """50% in-flight loss on every link: every RPC still completes via
+    retransmission, in order, with no duplicate deliveries reaching the
+    handler (the Echo responses stay aligned with their requests)."""
+    van, chaos = _reliable_stack(seed=seed, timeout=0.02, drop=0.5)
+    try:
+        Echo("echo", Postoffice("S0", van))
+        client = Customer("echo", Postoffice("W0", van))
+        for i in range(30):
+            ts = client.submit(
+                [Message(task=Task(TaskKind.PUSH, "echo"), recver="S0",
+                         values=[np.array([float(i)])])],
+                keep_responses=True,
+            )
+            assert client.wait(ts, timeout=60), f"rpc {i} never completed"
+            (resp,) = client.take_responses(ts)
+            np.testing.assert_allclose(resp.values[0], [2.0 * i])
+        assert chaos.injected_drops > 0  # the chaos actually did something
+        assert van.retransmits > 0  # ...and retransmission repaired it
+        assert van.gave_up == 0
+        assert van.flush(10)
+    finally:
+        van.close()
+
+
+def test_duplicates_are_suppressed_exactly():
+    """Pure duplication (no drop, no latency, generous resender timeout):
+    every injected duplicate is suppressed somewhere — stamped data/reply
+    dups by the receiver window (``dup_suppressed``), duplicated ACK frames
+    by the idempotent pending-pop (visible as acks_received > acks_sent).
+    The handler sees each logical message exactly once, in order."""
+    van, chaos = _reliable_stack(seed=7, timeout=30.0, duplicate=0.4)
+    try:
+        seen = []
+
+        class Recorder(Customer):
+            def handle_request(self, msg):
+                seen.append(float(msg.values[0][0]))
+                return msg.reply()
+
+        Recorder("rec", Postoffice("S0", van))
+        client = Customer("rec", Postoffice("W0", van))
+        for i in range(50):
+            ts = client.submit(
+                [Message(task=Task(TaskKind.PUSH, "rec"), recver="S0",
+                         values=[np.array([float(i)])])]
+            )
+            assert client.wait(ts, timeout=10)
+        assert seen == [float(i) for i in range(50)]  # exactly once, in order
+        assert chaos.injected_dups > 0
+
+        # Counter balance needs quiescence: the last duplicate deliveries
+        # ride recv threads that may still be draining after wait() returns.
+        def balanced():
+            ack_dups = van.acks_received - van.acks_sent
+            return van.dup_suppressed + ack_dups == chaos.injected_dups
+
+        assert _settle(balanced), (
+            f"dup accounting never balanced: suppressed={van.dup_suppressed} "
+            f"ack_dups={van.acks_received - van.acks_sent} "
+            f"injected={chaos.injected_dups}"
+        )
+        assert van.retransmits == 0  # generous timeout: no spurious retx
+    finally:
+        van.close()
+
+
+def test_give_up_after_retry_budget():
+    """A blackholed link (every frame swallowed in flight) exhausts the
+    retry budget: the resender stops, counts ``gave_up``, and leaves the
+    caller's deadline machinery in charge — cancel() then frees the task."""
+    van, chaos = _reliable_stack(seed=0, timeout=0.005, max_retries=3)
+    try:
+        Echo("echo", Postoffice("S0", van))
+        client = Customer("echo", Postoffice("W0", van))
+        chaos.partition("W0", "S0")  # requests vanish in flight
+        ts = client.submit(
+            [Message(task=Task(TaskKind.PUSH, "echo"), recver="S0")]
+        )
+        assert _settle(lambda: van.gave_up == 1, 10)
+        assert van.inflight() == 0
+        # the task is still pending — the caller's deadline owns it now
+        assert not client.wait(ts, timeout=0.05)
+        assert client.cancel(ts, "test deadline")
+        assert client.wait(ts, timeout=1)
+        assert client.pending_count() == 0
+    finally:
+        van.close()
+
+
+def test_give_up_hook_fires_with_the_dead_message():
+    gave = []
+    van, chaos = _reliable_stack(seed=0, timeout=0.005, max_retries=2)
+    van.on_give_up = gave.append
+    try:
+        chaos.partition("A", "B")
+        van.bind("B", lambda m: None)
+        assert van.send(
+            Message(task=Task(TaskKind.CONTROL, "x"), sender="A", recver="B")
+        )
+        assert _settle(lambda: len(gave) == 1, 10)
+        assert gave[0].recver == "B"
+    finally:
+        van.close()
+
+
+def test_asymmetric_partition_drops_one_direction():
+    """A -> B partitioned while B -> A flows — strictly more expressive than
+    the binary disconnect (which kills both directions at send time)."""
+    chaos = ChaosVan(LoopbackVan(), seed=0)
+    try:
+        got = []
+        chaos.bind("A", got.append)
+        chaos.bind("B", got.append)
+        chaos.partition("A", "B")
+        msg_ab = Message(task=Task(TaskKind.CONTROL, "x"), sender="A", recver="B")
+        msg_ba = Message(task=Task(TaskKind.CONTROL, "x"), sender="B", recver="A")
+        assert chaos.send(msg_ab)  # accepted... and lost in flight
+        assert chaos.send(msg_ba)
+        assert _settle(lambda: len(got) == 1)
+        time.sleep(0.05)  # grace: the partitioned copy must NOT trickle in
+        assert [m.sender for m in got] == ["B"]  # only B->A arrived
+        assert chaos.partition_drops == 1
+        chaos.heal()
+        assert chaos.send(msg_ab)
+        assert _settle(lambda: len(got) == 2)
+        assert [m.sender for m in got] == ["B", "A"]
+    finally:
+        chaos.close()
+
+
+def test_latency_preserves_fifo_and_jitter_reorders():
+    """Fixed delay keeps per-link FIFO (timer-wheel FIFO tiebreak on equal
+    deadlines); a reorder penalty lets successors overtake the hit message."""
+    chaos = ChaosVan(LoopbackVan(), seed=3, delay=0.02)
+    try:
+        got = []
+        chaos.bind("B", got.append)
+        for i in range(20):
+            chaos.send(Message(task=Task(TaskKind.CONTROL, "x", time=i),
+                               sender="A", recver="B"))
+        assert _settle(lambda: len(got) == 20)
+        assert [m.task.time for m in got] == list(range(20))  # FIFO held
+    finally:
+        chaos.close()
+
+    # now with reorder injection: at least one inversion must appear
+    chaos = ChaosVan(
+        LoopbackVan(), seed=3,
+        default=ChaosConfig(delay=0.002, reorder=0.4, reorder_delay=0.1),
+    )
+    try:
+        got = []
+        chaos.bind("B", got.append)
+        for i in range(20):
+            chaos.send(Message(task=Task(TaskKind.CONTROL, "x", time=i),
+                               sender="A", recver="B"))
+        assert _settle(lambda: len(got) == 20)
+        order = [m.task.time for m in got]
+        assert sorted(order) == list(range(20))  # nothing lost
+        assert order != list(range(20))  # ...but reordered
+        assert chaos.injected_reorders > 0
+    finally:
+        chaos.close()
+
+
+def test_seed_determinism_across_runs():
+    """The same seed yields the identical fault sequence: run a fixed
+    single-threaded send script twice, compare injected counters AND the
+    exact delivered sequence.  A different seed diverges."""
+
+    def run(seed):
+        chaos = ChaosVan(LoopbackVan(), seed=seed, drop=0.3, duplicate=0.2)
+        got = []
+        try:
+            chaos.bind("B", lambda m: got.append(m.task.time))
+            for i in range(200):
+                chaos.send(Message(task=Task(TaskKind.CONTROL, "x", time=i),
+                                   sender="A", recver="B"))
+            expect = 200 - chaos.injected_drops + chaos.injected_dups
+            assert _settle(lambda: len(got) == expect)
+            return (chaos.injected_drops, chaos.injected_dups, tuple(got))
+        finally:
+            chaos.close()
+
+    a = run(11)
+    b = run(11)
+    c = run(12)
+    assert a == b  # bit-identical fault schedule
+    assert a != c  # and the seed actually matters
+    assert a[0] > 0 and a[1] > 0
+
+
+def test_chaos_counters_merge_through_the_stack():
+    van, chaos = _reliable_stack(seed=1, drop=0.25, timeout=0.02)
+    try:
+        Echo("echo", Postoffice("S0", van))
+        client = Customer("echo", Postoffice("W0", van))
+        for i in range(10):
+            ts = client.submit(
+                [Message(task=Task(TaskKind.PUSH, "echo"), recver="S0")]
+            )
+            assert client.wait(ts, timeout=30)
+        merged = transport_counters(van)
+        # one flat dict carrying every layer: resender + chaos + loopback
+        assert merged["retransmits"] == van.retransmits
+        assert merged["chaos_drops"] == chaos.injected_drops
+        assert merged["sent"] > 0  # base LoopbackVan counters included
+    finally:
+        van.close()
+
+
+# ------------------------------------------------------------ e2e training
+
+
+def _table_cfgs():
+    return {
+        "w": TableConfig(
+            name="w", rows=ROWS, dim=1,
+            optimizer=OptimizerConfig(kind="adagrad", learning_rate=0.1),
+        )
+    }
+
+
+def _batches():
+    data = SyntheticCTR(key_space=4 * ROWS, nnz=8, batch_size=128, seed=3)
+    return [data.next_batch() for _ in range(STEPS)]
+
+
+def _train(worker, batches, on_step=None):
+    losses = []
+    for i, (keys, labels) in enumerate(batches):
+        w_pos = worker.pull_sync("w", keys, timeout=60)
+        g, _gb, loss = linear.grad_rows(jnp.asarray(w_pos), jnp.asarray(labels))
+        worker.push_sync("w", keys, np.asarray(g) / labels.shape[0], timeout=60)
+        losses.append(float(loss))
+        if on_step is not None:
+            on_step(i)
+    return losses
+
+
+def _clean_reference():
+    """Uninterrupted run on a clean LoopbackVan.
+
+    Returns (losses, applied_pushes): the second is the ground truth for the
+    exactly-once accounting under chaos — same logical push legs, so the
+    chaos run's servers must count the identical number of applies.
+    """
+    van = LoopbackVan()
+    try:
+        servers = [
+            KVServer(Postoffice(f"S{s}", van), _table_cfgs(), s, NUM_SERVERS)
+            for s in range(NUM_SERVERS)
+        ]
+        worker = KVWorker(Postoffice("W0", van), _table_cfgs(), NUM_SERVERS)
+        losses = _train(worker, _batches())
+        return losses, sum(s.pushes for s in servers)
+    finally:
+        van.close()
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_lr_training_under_5pct_drop_matches_clean_run(seed):
+    """Acceptance: LR training under ChaosVan(drop=0.05) wrapped by
+    ReliableVan reaches the clean-run loss EXACTLY — per-step sync plus
+    exactly-once delivery makes the trajectory bitwise the clean one (no
+    lost pushes, no double-applied pushes) — and the servers' applied-push
+    count equals the clean run's (dedup suppressed every extra delivery)."""
+    ref_losses, ref_applied = _clean_reference()
+
+    van, chaos = _reliable_stack(seed=seed, timeout=0.1, drop=0.05)
+    try:
+        servers = [
+            KVServer(Postoffice(f"S{s}", van), _table_cfgs(), s, NUM_SERVERS)
+            for s in range(NUM_SERVERS)
+        ]
+        worker = KVWorker(Postoffice("W0", van), _table_cfgs(), NUM_SERVERS)
+        losses = _train(worker, _batches())
+        np.testing.assert_allclose(losses, ref_losses, rtol=1e-7, atol=0)
+        assert sum(s.pushes for s in servers) == ref_applied  # exactly once
+        assert van.flush(10)  # every send eventually acked
+        assert van.gave_up == 0
+        assert chaos.injected_drops > 0  # the run was actually lossy
+        assert worker.pull_retries == 0  # transport repaired it all
+    finally:
+        van.close()
+
+
+def test_lr_training_survives_server_kill_and_promotion_under_drop():
+    """Acceptance: mid-run S0 kill + hot-standby promotion under 1% drop —
+    training completes WITHOUT a checkpoint rewind, on the exact clean
+    trajectory (sync replica chain + exactly-once forwarding => the standby
+    holds the primary's full state at the kill instant)."""
+    ref_losses, _ = _clean_reference()
+
+    van, chaos = _reliable_stack(seed=5, timeout=0.1, drop=0.01)
+    try:
+        primaries, standbys = replica_lib.make_replicated_servers(
+            van, _table_cfgs(), NUM_SERVERS, sync=True
+        )
+        assert primaries
+        worker = KVWorker(Postoffice("W0", van), _table_cfgs(), NUM_SERVERS)
+
+        kill_after = STEPS // 2
+
+        def on_step(i):
+            if i != kill_after - 1:
+                return
+            van.unbind("S0")  # the primary process dies mid-run
+            replica_lib.promote(van, standbys[0], "S0")
+
+        losses = _train(worker, _batches(), on_step=on_step)
+        np.testing.assert_allclose(losses, ref_losses, rtol=1e-7, atol=0)
+    finally:
+        van.close()
+
+
+def test_pull_retransmits_into_promotion_window():
+    """A pull issued while S0 is dead keeps retransmitting into the void;
+    promotion rebinds the identity mid-retry and the SAME pull completes —
+    no worker-visible error, no app-layer re-issue."""
+    van, _chaos = _reliable_stack(seed=0, timeout=0.05)
+    try:
+        primaries, standbys = replica_lib.make_replicated_servers(
+            van, _table_cfgs(), NUM_SERVERS, sync=True
+        )
+        assert primaries
+        worker = KVWorker(Postoffice("W0", van), _table_cfgs(), NUM_SERVERS)
+        keys, _labels = _batches()[0]
+        worker.pull_sync("w", keys, timeout=60)  # warm path while healthy
+
+        van.unbind("S0")  # dead: sends to S0 now vanish at the base van
+        ts = worker.pull("w", keys)
+        t = threading.Timer(
+            0.3, lambda: replica_lib.promote(van, standbys[0], "S0")
+        )
+        t.start()
+        try:
+            out = worker.pull_result(ts, timeout=60)
+        finally:
+            t.join()
+        assert out.shape == keys.shape
+        assert worker.pull_retries == 0  # transport-level retry was enough
+    finally:
+        van.close()
+
+
+def test_pull_deadline_retry_against_promoted_server():
+    """The worker-level deadline path: the transport gives up fast (tiny
+    retry budget), the pull times out, Customer.cancel frees the task, and
+    the single app-layer re-issue lands on the promoted standby."""
+    van, _chaos = _reliable_stack(seed=0, timeout=0.01, max_retries=1)
+    try:
+        primaries, standbys = replica_lib.make_replicated_servers(
+            van, _table_cfgs(), NUM_SERVERS, sync=True
+        )
+        assert primaries
+        worker = KVWorker(Postoffice("W0", van), _table_cfgs(), NUM_SERVERS)
+        keys, _labels = _batches()[0]
+        worker.pull_sync("w", keys, timeout=60)
+
+        van.unbind("S0")
+        ts = worker.pull("w", keys)
+        assert not worker.wait(ts, timeout=0.3)  # stuck: S0 is gone
+        replica_lib.promote(van, standbys[0], "S0")
+        out = worker.pull_result(ts, timeout=2)  # cancel + retry inside
+        assert out.shape == keys.shape
+        assert worker.pull_retries == 1
+        assert worker.pending_count() == 0  # nothing leaked
+    finally:
+        van.close()
+
+
+def test_chaos_e2e_seed_deterministic():
+    """Two consecutive runs of the seeded 5%-drop training produce identical
+    losses AND identical injected-fault counters (acceptance: chaos tests
+    are seed-deterministic across consecutive runs)."""
+
+    def run():
+        van, chaos = _reliable_stack(seed=9, timeout=0.25, drop=0.05)
+        try:
+            for s in range(NUM_SERVERS):
+                KVServer(Postoffice(f"S{s}", van), _table_cfgs(), s, NUM_SERVERS)
+            worker = KVWorker(Postoffice("W0", van), _table_cfgs(), NUM_SERVERS)
+            losses = _train(worker, _batches())
+            assert van.flush(10)
+            return losses, chaos.injected_drops
+        finally:
+            van.close()
+
+    losses_a, drops_a = run()
+    losses_b, drops_b = run()
+    np.testing.assert_allclose(losses_a, losses_b, rtol=0, atol=0)
+    assert drops_a == drops_b
+    assert drops_a > 0
+
+
+def test_reliable_over_tcp_van_sockets():
+    """The reliability layer is Van-agnostic: the same protocol repairs
+    in-flight loss over the native TcpVan (chaos under the worker's
+    resender; ACKs from the server ride the peer-connection reply path)."""
+    from parameter_server_tpu import native
+
+    if native.load("tcpvan") is None:  # pragma: no cover
+        pytest.skip("no native toolchain for tcpvan")
+    from parameter_server_tpu.core.tcp_van import TcpVan
+
+    van_s = ReliableVan(TcpVan(), timeout=0.1, backoff=1.0, max_retries=60)
+    chaos_w = ChaosVan(TcpVan(), seed=4, drop=0.3)
+    van_w = ReliableVan(chaos_w, timeout=0.1, backoff=1.0, max_retries=60)
+    try:
+        cfgs = _table_cfgs()
+        KVServer(Postoffice("S0", van_s), cfgs, 0, 1)
+        van_w.add_route("S0", van_s.address)
+        worker = KVWorker(Postoffice("W0", van_w), cfgs, 1)
+        keys, labels = _batches()[0]
+        for _ in range(10):  # enough traffic that 30% loss must bite
+            w_pos = worker.pull_sync("w", keys, timeout=60)
+            assert w_pos.shape == keys.shape
+        g, _gb, _loss = linear.grad_rows(jnp.asarray(w_pos), jnp.asarray(labels))
+        worker.push_sync("w", keys, np.asarray(g) / labels.shape[0], timeout=60)
+        assert chaos_w.injected_drops > 0
+        assert van_w.retransmits > 0  # the losses crossed the repair path
+        assert van_w.gave_up == 0 and van_s.gave_up == 0
+    finally:
+        van_w.close()
+        van_s.close()
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+def test_stress_sweep_heavy_chaos(seed):
+    """Long stress sweep: drop + dup + jittered latency + reorder all at
+    once — the trajectory still equals the clean run exactly, across a
+    seed matrix."""
+    ref_losses, ref_applied = _clean_reference()
+
+    chaos = ChaosVan(
+        LoopbackVan(), seed=seed,
+        default=ChaosConfig(drop=0.15, duplicate=0.1, reorder=0.2,
+                            delay=0.001, jitter=0.004, reorder_delay=0.01),
+    )
+    van = ReliableVan(
+        chaos, timeout=0.05, backoff=1.0, max_retries=200, seed=seed
+    )
+    try:
+        servers = [
+            KVServer(Postoffice(f"S{s}", van), _table_cfgs(), s, NUM_SERVERS)
+            for s in range(NUM_SERVERS)
+        ]
+        worker = KVWorker(Postoffice("W0", van), _table_cfgs(), NUM_SERVERS)
+        losses = _train(worker, _batches())
+        np.testing.assert_allclose(losses, ref_losses, rtol=1e-7, atol=0)
+        assert sum(s.pushes for s in servers) == ref_applied
+        assert van.gave_up == 0
+    finally:
+        van.close()

@@ -144,6 +144,103 @@ def test_ssp_dead_worker_excluded():
     assert ctl.wait_turn(0, 2, timeout=5)  # dead worker no longer gates
 
 
+class SlowEcho(Customer):
+    """Echo that answers after ``delay`` seconds (deadline-path fixture)."""
+
+    delay = 0.5
+
+    def handle_request(self, msg):
+        time.sleep(self.delay)
+        return msg.reply(values=[v * 2 for v in msg.values])
+
+
+def test_cancel_frees_pending_and_ignores_late_response():
+    van = LoopbackVan()
+    try:
+        server_post = Postoffice("S0", van)
+        worker_post = Postoffice("W0", van)
+        SlowEcho("echo", server_post)
+        client = Customer("echo", worker_post)
+        msg = Message(
+            task=Task(TaskKind.PUSH, "echo"),
+            recver="S0",
+            values=[np.array([1.0])],
+        )
+        ts = client.submit([msg], keep_responses=True)
+        assert not client.wait(ts, timeout=0.05)  # still cooking
+        assert client.cancel(ts, "test deadline")
+        assert client.wait(ts, timeout=1)  # finalized NOW
+        assert client.pending_count() == 0  # nothing leaked
+        assert client.errors(ts) == ["test deadline"]
+        with pytest.raises(RuntimeError, match="test deadline"):
+            client.check(ts)
+        # the late response lands after cancel: ignored, no double-finish
+        time.sleep(SlowEcho.delay + 0.3)
+        assert client.take_responses(ts) == []
+        assert client.cancel(ts) is False  # already completed
+    finally:
+        van.close()
+
+
+def test_unknown_customer_request_gets_error_reply():
+    """A request for a customer the receiving node never registered must
+    complete the sender's wait with a reportable error — the reference
+    logged and dropped it, hanging the requester's wait(ts) forever."""
+    van = LoopbackVan()
+    try:
+        Postoffice("S0", van)  # node exists, but registers no customer
+        client = Customer("nosuch", Postoffice("W0", van))
+        ts = client.submit(
+            [Message(task=Task(TaskKind.PUSH, "nosuch"), recver="S0")],
+            keep_responses=True,
+        )
+        assert client.wait(ts, timeout=5)  # does NOT hang
+        with pytest.raises(RuntimeError, match="unknown customer 'nosuch'"):
+            client.check(ts)
+    finally:
+        van.close()
+
+
+def test_callbacks_run_on_shared_executor_threads():
+    """Completion callbacks ride a small shared daemon pool, not a fresh
+    thread per callback (unbounded thread creation under async push rates)."""
+    from parameter_server_tpu.utils.threads import CALLBACKS
+
+    van, server, client = _make_pair()
+    try:
+        thread_names = []
+        lock = threading.Lock()
+
+        def cb(responses):
+            with lock:
+                thread_names.append(threading.current_thread().name)
+
+        for i in range(50):
+            client.submit(
+                [
+                    Message(
+                        task=Task(TaskKind.PUSH, "echo"),
+                        recver="S0",
+                        values=[np.array([float(i)])],
+                    )
+                ],
+                callback=cb,
+            )
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            with lock:
+                if len(thread_names) == 50:
+                    break
+            time.sleep(0.01)
+        with lock:
+            names = set(thread_names)
+        assert len(thread_names) == 50
+        assert all(n.startswith("ps-callback") for n in names)
+        assert len(names) <= CALLBACKS.workers  # bounded pool, threads reused
+    finally:
+        van.close()
+
+
 def test_wait_time_for_matches_reference_dag():
     bsp = ConsistencyController(ConsistencyConfig(ConsistencyMode.BSP), 1)
     ssp = ConsistencyController(

@@ -119,6 +119,150 @@ def test_death_unblocks_ssp_clock():
         van.close()
 
 
+def test_barrier_completes_and_scheduler_drains():
+    """Happy path: every participant's barrier() returns True, and the
+    scheduler's barrier_drain observes all the acks (last-observer safety)."""
+    import threading
+
+    van = LoopbackVan()
+    try:
+        sched, managers, _ = launch_local_cluster(
+            van, num_workers=2, num_servers=1
+        )
+        results = {}
+
+        def enter(nid):
+            results[nid] = managers[nid].barrier("step", 2, timeout=10)
+
+        threads = [
+            threading.Thread(target=enter, args=(wid,)) for wid in ("W0", "W1")
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=15)
+        assert results == {"W0": True, "W1": True}
+        assert sched.barrier_drain("step", 2, timeout=10)
+        # no leaked in-flight tasks on the participants
+        for wid in ("W0", "W1"):
+            assert managers[wid].pending_count() == 0
+    finally:
+        van.close()
+
+
+def test_barrier_timeout_returns_false_without_leaking():
+    """Short of quorum: barrier() must give up at its deadline, with the
+    poll-round task bookkeeping fully reclaimed (the old path leaked one
+    pending entry per timed-out round)."""
+    van = LoopbackVan()
+    try:
+        sched, managers, _ = launch_local_cluster(
+            van, num_workers=2, num_servers=1
+        )
+        t0 = time.time()
+        assert not managers["W0"].barrier("lonely", 2, timeout=0.5, poll=0.02)
+        assert time.time() - t0 < 5
+        assert managers["W0"].pending_count() == 0
+        # and the scheduler never saw the quorum either
+        assert not sched.barrier_drain("lonely", 2, timeout=0.2, poll=0.02)
+    finally:
+        van.close()
+
+
+def test_barrier_unreachable_scheduler_cancels_stuck_round():
+    """Scheduler silently unreachable (in-flight loss, not send-time
+    rejection): the poll round's wait() times out and the task must be
+    cancelled — _pending frees instead of leaking per round."""
+    from parameter_server_tpu.core.chaos import ChaosVan
+    from parameter_server_tpu.core.resender import ReliableVan
+
+    van = ReliableVan(
+        ChaosVan(LoopbackVan(), seed=0), timeout=0.05, max_retries=2
+    )
+    try:
+        sched, managers, _ = launch_local_cluster(
+            van, num_workers=1, num_servers=1
+        )
+        chaos = van.inner
+        assert sched.wait_ready(5)
+        chaos.partition("W0", "H")  # requests vanish in flight from now on
+        assert not managers["W0"].barrier("b", 2, timeout=0.6, poll=0.02)
+        assert managers["W0"].pending_count() == 0  # cancel freed the round
+    finally:
+        van.close()
+
+
+def test_barrier_survives_chaos_message_loss():
+    """Barrier over ReliableVan(ChaosVan(drop=0.2)): every enter/poll/ack
+    leg is repaired by retransmission, so the quorum completes exactly as on
+    a clean van (satellite: barrier correctness under seeded chaos)."""
+    import threading
+
+    from parameter_server_tpu.core.chaos import ChaosVan
+    from parameter_server_tpu.core.resender import ReliableVan
+
+    van = ReliableVan(
+        ChaosVan(LoopbackVan(), seed=2, drop=0.2),
+        timeout=0.05, backoff=1.0, max_retries=60,
+    )
+    try:
+        sched, managers, _ = launch_local_cluster(
+            van, num_workers=2, num_servers=1
+        )
+        results = {}
+
+        def enter(nid):
+            results[nid] = managers[nid].barrier("noisy", 2, timeout=30)
+
+        threads = [
+            threading.Thread(target=enter, args=(wid,)) for wid in ("W0", "W1")
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert results == {"W0": True, "W1": True}
+        assert sched.barrier_drain("noisy", 2, timeout=30)
+        assert van.inner.injected_drops > 0  # the chaos actually bit
+    finally:
+        van.close()
+
+
+def test_heartbeat_rejoin_rebroadcasts_table_row():
+    """Recovery path of _on_heartbeat: a heartbeat from a dead-marked node
+    re-broadcasts its row to the live peers and fires on_node_added — peers
+    that processed REMOVE_NODE relearn the member (re-join, not re-register)."""
+    van = LoopbackVan()
+    try:
+        sched, managers, _ = launch_local_cluster(
+            van, num_workers=2, num_servers=1, heartbeat_timeout=0.2
+        )
+        readded = []
+        sched.on_node_added.append(readded.append)
+
+        time.sleep(0.3)
+        managers["W0"].send_heartbeat()
+        managers["S0"].send_heartbeat()
+        time.sleep(0.05)
+        assert sched.check_heartbeats() == ["W1"]
+        deadline = time.time() + 5
+        while time.time() < deadline and managers["W0"].is_alive("W1"):
+            time.sleep(0.01)
+        assert not managers["W0"].is_alive("W1")  # peer processed the death
+
+        managers["W1"].send_heartbeat()  # the node was only slow, not dead
+        deadline = time.time() + 5
+        while time.time() < deadline and not (
+            sched.is_alive("W1") and managers["W0"].is_alive("W1")
+        ):
+            time.sleep(0.01)
+        assert sched.is_alive("W1")
+        assert managers["W0"].is_alive("W1")  # rebroadcast reached the peer
+        assert "W1" in readded  # ADD_NODE-on-recovery callback fired
+    finally:
+        van.close()
+
+
 def test_workload_pool_basic_and_reassignment():
     pool = WorkloadPool(["f0", "f1", "f2", "f3"])
     w0 = pool.get("W0")
