@@ -374,9 +374,11 @@ def _build_sptp_lm(cfg: AppConfig) -> Callable[[], dict]:
     attention over an ``sp`` axis x tensor parallelism over ``model`` x
     adamw moments FSDP over ``sp``, one GSPMD program.  Mesh shape comes
     from ``topology.mesh_shape`` (data, model) reinterpreted as
-    (sp, model) — falls back to all-devices-on-sp x model 1.  Sequence
-    length knob as in the ``sp_lm`` app (``data.nnz * 64``, rounded to a
-    multiple of sp)."""
+    (sp, model) — ``None`` (the schema default, "unset") falls back to
+    all-devices-on-sp x model 1, while an EXPLICIT shape — (1, 1)
+    included — is validated against the available devices (ADVICE r5 #4).
+    Sequence length knob as in the ``sp_lm`` app (``data.nnz * 64``,
+    rounded to a multiple of sp)."""
 
     def run() -> dict:
         import jax
@@ -387,8 +389,12 @@ def _build_sptp_lm(cfg: AppConfig) -> Callable[[], dict]:
 
         devices = jax.devices()
         n_dev = len(devices)
-        mesh_cfg = tuple(cfg.topology.mesh_shape)
-        if mesh_cfg == (1, 1):  # schema default: all devices on sp, no TP
+        mesh_cfg = (
+            None
+            if cfg.topology.mesh_shape is None
+            else tuple(cfg.topology.mesh_shape)
+        )
+        if mesh_cfg is None:  # unset: all devices on sp, no TP
             sp_n, tp_n = n_dev, 1
         elif len(mesh_cfg) == 2 and mesh_cfg[0] * mesh_cfg[1] == n_dev:
             sp_n, tp_n = mesh_cfg

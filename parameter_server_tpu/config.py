@@ -57,7 +57,10 @@ class TopologyConfig:
     num_servers: int = 1
     #: mesh axis sizes (data, model); data axis carries DP gradient psum
     #: (the NCCL-pre-reduction replacement), model axis carries table shards.
-    mesh_shape: Tuple[int, ...] = (1, 1)
+    #: None = unset: apps pick their own default layout (e.g. sptp_lm puts
+    #: all devices on sp).  An explicit shape — including (1, 1) — is
+    #: validated against the available devices like any other.
+    mesh_shape: Optional[Tuple[int, ...]] = None
     mesh_axis_names: Tuple[str, ...] = ("data", "model")
 
 

@@ -6,6 +6,7 @@ control-plane and DCN-plane traffic.
 """
 
 from parameter_server_tpu.core.chaos import ChaosConfig, ChaosVan
+from parameter_server_tpu.core.coalesce import CoalescingVan
 from parameter_server_tpu.core.messages import (
     Message,
     NodeRole,
@@ -20,6 +21,7 @@ from parameter_server_tpu.core.van import LoopbackVan, Van, VanWrapper
 __all__ = [
     "ChaosConfig",
     "ChaosVan",
+    "CoalescingVan",
     "LoopbackVan",
     "Message",
     "NodeRole",

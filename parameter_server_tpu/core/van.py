@@ -47,6 +47,15 @@ class Van:
     def close(self) -> None:
         pass
 
+    def flush(self, timeout: float = 5.0) -> bool:
+        """Block until buffered / in-flight frames are settled.
+
+        Base transports deliver synchronously, so this is a no-op; layers
+        that buffer (``CoalescingVan``) or track in-flight frames
+        (``ReliableVan``) override it.  Returns False on timeout.
+        """
+        return True
+
     def counters(self) -> dict:
         """Dashboard counters (merged across a wrapper stack by
         ``utils.metrics.transport_counters``)."""
@@ -77,6 +86,11 @@ class VanWrapper(Van):
 
     def close(self) -> None:
         self.inner.close()
+
+    def flush(self, timeout: float = 5.0) -> bool:
+        # explicit (not via __getattr__: the base-class no-op would shadow
+        # delegation) so flush() on any stack reaches every buffering layer
+        return self.inner.flush(timeout)
 
     def __getattr__(self, name):
         # only reached for attributes not defined on the wrapper itself

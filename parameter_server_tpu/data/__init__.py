@@ -5,6 +5,7 @@ SURVEY.md #18.  Text parsing runs in native C++ (``native/src/textparse.cc``)
 with bit-identical numpy fallbacks.
 """
 
+from parameter_server_tpu.data.prefetch import PrefetchPipeline
 from parameter_server_tpu.data.reader import (
     SlotReader,
     StreamReader,
@@ -20,6 +21,7 @@ from parameter_server_tpu.data.text import (
 
 __all__ = [
     "CSRBatch",
+    "PrefetchPipeline",
     "SlotReader",
     "StreamReader",
     "SyntheticCTR",

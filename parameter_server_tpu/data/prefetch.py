@@ -1,0 +1,171 @@
+"""Overlapped ingest: background batch assembly + H2D into a bounded queue.
+
+The r5 bench exposed an inversion: the "pipelined" dispatch loop trailed the
+*unoverlapped* host-fed phase sum because batch assembly (~0.14 s/window of
+uint64 validation, stacking, casting) and the host→device transfer sat on
+the critical path between device dispatches.  :class:`PrefetchPipeline`
+moves both onto a producer thread feeding a depth-``depth`` queue of
+device-resident blocks, so the host assembles block ``i+1`` (and stages its
+H2D copy) while the device executes block ``i`` — the MLPerf TPU-pod infeed
+lesson (arxiv 1909.09756) applied to the scan-block trainer.
+
+Determinism: one producer thread calling ``make_block(0), make_block(1),
+...`` in order, one bounded FIFO — consumers see exactly the sequence a
+serial loop would produce.  ``depth=2`` is classic double buffering: the
+producer stays at most one block ahead, bounding host memory and keeping
+backpressure.
+
+Shutdown is leak-free: :meth:`close` (or the context manager) stops the
+producer even when it is blocked on a full queue, joins the thread, and
+drains the queue so donated device buffers are released.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Callable, Iterator, Optional
+
+import jax
+
+#: queue sentinel: producer finished (``limit`` reached).
+_DONE = object()
+
+
+class PrefetchPipeline:
+    """Double-buffered producer of device-resident input blocks.
+
+    Parameters
+    ----------
+    make_block:
+        ``make_block(i) -> block`` builds the ``i``-th host block (any
+        pytree of numpy arrays).  Runs on the producer thread — keep all
+        per-block host work (assembly, validation, casting) here so none of
+        it lands on the consumer's critical path.
+    depth:
+        queue capacity (2 = double buffering: one block in flight on the
+        device, one staged).
+    limit:
+        number of blocks to produce (None = unbounded; the consumer stops
+        by closing the pipeline).
+    device_put:
+        override the H2D transfer (default ``jax.device_put``); tests pass
+        an identity to run device-free.
+    """
+
+    def __init__(
+        self,
+        make_block: Callable[[int], Any],
+        *,
+        depth: int = 2,
+        limit: Optional[int] = None,
+        device_put: Optional[Callable[[Any], Any]] = None,
+    ) -> None:
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        self._make_block = make_block
+        self._limit = limit
+        self._device_put = device_put if device_put is not None else jax.device_put
+        self._q: "queue.Queue[Any]" = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._error: Optional[BaseException] = None
+        # counters (dashboard ``prefetch`` attachment)
+        self._lock = threading.Lock()
+        self._produced = 0
+        self._consumed = 0
+        self._stalls = 0
+        self._stall_s = 0.0
+        self._thread = threading.Thread(
+            target=self._produce, name="prefetch-producer", daemon=True
+        )
+        self._thread.start()
+
+    # -- producer -----------------------------------------------------------
+    def _produce(self) -> None:
+        i = 0
+        try:
+            while not self._stop.is_set():
+                if self._limit is not None and i >= self._limit:
+                    self._put(_DONE)
+                    return
+                block = self._device_put(self._make_block(i))
+                if not self._put(block):
+                    return  # stopped while waiting on a full queue
+                with self._lock:
+                    self._produced += 1
+                i += 1
+        except BaseException as e:  # noqa: BLE001 — surface on the consumer
+            self._error = e
+            self._put(_DONE)
+
+    def _put(self, item: Any) -> bool:
+        """put() that stays responsive to close() on a full queue."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    # -- consumer -----------------------------------------------------------
+    def get(self) -> Any:
+        """Next device block; raises StopIteration when ``limit`` blocks
+        were consumed.  Time spent waiting on an empty queue is counted as a
+        prefetch stall (the producer was the bottleneck)."""
+        try:
+            item = self._q.get_nowait()
+        except queue.Empty:
+            t0 = time.perf_counter()
+            item = self._q.get()
+            with self._lock:
+                self._stalls += 1
+                self._stall_s += time.perf_counter() - t0
+        if item is _DONE:
+            self._q.put(_DONE)  # keep later get()s terminating too
+            if self._error is not None:
+                raise self._error
+            raise StopIteration
+        with self._lock:
+            self._consumed += 1
+        return item
+
+    def __iter__(self) -> Iterator[Any]:
+        while True:
+            try:
+                yield self.get()
+            except StopIteration:
+                return
+
+    # -- lifecycle / metrics ------------------------------------------------
+    def counters(self) -> dict:
+        with self._lock:
+            return {
+                "prefetch_produced": self._produced,
+                "prefetch_consumed": self._consumed,
+                "prefetch_stalls": self._stalls,
+                "prefetch_stall_s": round(self._stall_s, 4),
+            }
+
+    def close(self) -> None:
+        """Stop the producer, join it, drain the queue (leak-free)."""
+        self._stop.set()
+        # unblock a producer stuck in put() by making room
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        self._thread.join(timeout=10)
+        while True:  # drain anything the producer squeezed in while dying
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+
+    def __enter__(self) -> "PrefetchPipeline":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

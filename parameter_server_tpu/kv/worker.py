@@ -19,8 +19,9 @@ Pipeline per call (SURVEY.md §3.2 hot path, TPU mapping):
 
 from __future__ import annotations
 
+import contextlib
 import functools
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -109,7 +110,11 @@ class KVWorker(Customer):
                         values=[combined[seg]],
                     )
                 )
-            return self.submit(msgs)
+            # window: under a CoalescingVan the burst flushes at submit
+            # exit (no flush-timer latency); nested inside push_many's
+            # window it coalesces across tables instead
+            with self.coalesce_window():
+                return self.submit(msgs)
 
     def push_device(self, table: str, keys: np.ndarray, values) -> int:
         """Device-resident push: gradient rows never leave the device.
@@ -144,7 +149,37 @@ class KVWorker(Customer):
                         values=[combined[seg]],
                     )
                 )
-            return self.submit(msgs)
+            with self.coalesce_window():
+                return self.submit(msgs)
+
+    def coalesce_window(self):
+        """Context manager batching this worker's sends per destination.
+
+        When the Postoffice's Van stack includes a
+        :class:`~parameter_server_tpu.core.coalesce.CoalescingVan`, every
+        message sent inside the window is bundled per server — a multi-table
+        push pays the per-server frame overhead (pickle header, seq/ACK,
+        filter pass) once.  A no-op (null context) on plain stacks, so
+        callers never need to know what the Van is.
+        """
+        win = getattr(self.post.van, "window", None)
+        return win() if callable(win) else contextlib.nullcontext()
+
+    def push_many(
+        self, updates: Dict[str, Tuple[np.ndarray, np.ndarray]]
+    ) -> Dict[str, int]:
+        """Push several tables' gradients in one coalescing window.
+
+        ``updates``: ``{table: (keys, values)}``.  Returns ``{table: ts}``
+        — one timestamp per table (responses from the same server must not
+        share a ts), all of whose wire messages coalesce into one frame per
+        server.  ``wait()`` each ts as usual.
+        """
+        with self.coalesce_window():
+            return {
+                t: self.push(t, keys, values)
+                for t, (keys, values) in updates.items()
+            }
 
     # -- pull ---------------------------------------------------------------
     def pull(self, table: str, keys: np.ndarray) -> int:
@@ -166,7 +201,8 @@ class KVWorker(Customer):
                     keys=local,
                 )
             )
-        ts = self.submit(msgs, keep_responses=True)
+        with self.coalesce_window():
+            ts = self.submit(msgs, keep_responses=True)
         self._pull_plans[ts] = {
             "order": order,
             "inverse": inverse,

@@ -36,7 +36,11 @@ from parameter_server_tpu.kv.table import KVTable
 from parameter_server_tpu.kv.worker import KVWorker
 from parameter_server_tpu.models import linear
 from parameter_server_tpu.utils import metrics as metrics_lib
-from parameter_server_tpu.utils.keys import HashLocalizer, localize_to_slots
+from parameter_server_tpu.utils.keys import (
+    HashLocalizer,
+    ensure_uint32_keys,
+    localize_to_slots,
+)
 from parameter_server_tpu.utils.threads import run_threads
 
 Batch = Tuple[np.ndarray, np.ndarray]  # (keys [B, nnz], labels [B])
@@ -170,25 +174,27 @@ class LocalLRTrainer:
         """
         if not self.device_hash:
             raise ValueError("step_block requires device_hash=True")
-        keys_block = np.asarray(keys_block)
-        if keys_block.dtype != np.uint32:
-            # The device-hash path truncates to uint32; keys >= 2**32 - 1
-            # would silently wrap (or alias PAD_KEY32 and route to the trash
-            # row), corrupting training with no error — enforce the
-            # documented "< 2**32 - 1 unless PAD" contract instead.
-            from parameter_server_tpu.utils.keys import PAD_KEY
+        keys_block = ensure_uint32_keys(keys_block)
+        return self.step_block_device(
+            jnp.asarray(keys_block), jnp.asarray(labels_block)
+        )
 
-            kb = keys_block.astype(np.uint64)  # signed -1 coerces to PAD_KEY
-            # cheap scalar early-out: only blocks containing a suspicious key
-            # (>= uint32 max; PAD_KEY itself is uint64 max) pay for the mask
-            if int(kb.max(initial=0)) >= 0xFFFF_FFFF:
-                bad = (kb != PAD_KEY) & (kb >= np.uint64(0xFFFF_FFFF))
-                if bad.any():
-                    raise ValueError(
-                        "step_block(device_hash): keys must be < 2**32 - 1 "
-                        f"(or PAD_KEY); got {int(kb[bad][0])}"
-                    )
-            keys_block = kb
+    def step_block_device(
+        self, keys_block: jax.Array, labels_block: jax.Array
+    ) -> jax.Array:
+        """:meth:`step_block` for ALREADY device-resident uint32 inputs.
+
+        The overlapped ingest path (``data.prefetch.PrefetchPipeline``)
+        validates and casts keys on its producer thread
+        (``utils.keys.ensure_uint32_keys``) and stages the H2D copy there
+        too, so this method is pure dispatch — no host work on the critical
+        path between scan blocks.  Callers own the validation contract:
+        feed it anything but checked uint32 keys and bad keys wrap
+        silently, which is why the host-side :meth:`step_block` remains the
+        default entry point.
+        """
+        if not self.device_hash:
+            raise ValueError("step_block_device requires device_hash=True")
         t = self.table
         (
             t.value,
@@ -201,13 +207,29 @@ class LocalLRTrainer:
             t.state,
             self.bias,
             self.bias_state,
-            jnp.asarray(keys_block.astype(np.uint32, copy=False)),
-            jnp.asarray(labels_block),
+            keys_block,
+            labels_block,
             self.optimizer,
             self.cfg.rows,
             self.localizer.seed,
         )
         self.step_count += int(keys_block.shape[0])
+        return losses
+
+    def train_stream(self, pipeline, num_blocks: Optional[int] = None) -> list:
+        """Drain a :class:`~parameter_server_tpu.data.prefetch.PrefetchPipeline`
+        of ``(keys_block, labels_block)`` device pairs through
+        :meth:`step_block_device`; returns the per-block device loss arrays.
+
+        The prefetch producer assembles and stages block ``i+1`` while the
+        device executes block ``i`` — the ingest-overlap loop the scan-block
+        design was built for.
+        """
+        losses = []
+        for kd, yd in pipeline:
+            losses.append(self.step_block_device(kd, yd))
+            if num_blocks is not None and len(losses) >= num_blocks:
+                break
         return losses
 
     def train(self, batch_fn: BatchFn, num_steps: int) -> None:

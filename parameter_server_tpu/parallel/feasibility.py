@@ -267,7 +267,10 @@ def dlrm_feasibility(
     rows = 1 << rows_log2
     cfg = TableConfig(
         name="emb", rows=rows, dim=dim,
-        optimizer=OptimizerConfig(kind=optimizer, learning_rate=0.05),
+        # the caller's learning_rate drives BOTH planes: the embedding
+        # optimizer here and the MLP adam below (it was silently pinned to
+        # 0.05 for the table — ADVICE r5 #2)
+        optimizer=OptimizerConfig(kind=optimizer, learning_rate=learning_rate),
     )
     opt = make_optimizer(cfg.optimizer)
     model = DLRM(bottom_mlp=(64, 32), top_mlp=(64, 32), emb_dim=dim)

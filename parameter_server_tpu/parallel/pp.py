@@ -411,7 +411,19 @@ def make_pp_step(
         local = jax.tree.map(lambda a: a[0], stage_params_local)
         return stage_module.apply({"params": local}, x)
 
+    def check_micro(tokens_micro):
+        # trace-time twin of the PipelinedLMTrainer ctor check: AOT and
+        # feasibility callers reach here without the trainer, and an uneven
+        # microbatch split otherwise dies as an opaque GSPMD sharding error
+        # inside the shard_map (ADVICE r5 #3)
+        n_micro = tokens_micro.shape[0]
+        if n_micro % n_stages:
+            raise ValueError(
+                f"n_micro {n_micro} % pp stages {n_stages} != 0"
+            )
+
     def loss_from(params, tokens_micro):
+        check_micro(tokens_micro)
         x = jnp.take(params["embed"], tokens_micro, axis=0)
 
         def body(stages, x_micro, tokens_ref):
@@ -444,6 +456,7 @@ def make_pp_step(
         return tfm.causal_lm_loss(logits, tgt)
 
     def loss_and_grads_1f1b(params, tokens_micro):
+        check_micro(tokens_micro)
         x, vjp_emb = jax.vjp(
             lambda e: jnp.take(e, tokens_micro, axis=0), params["embed"]
         )

@@ -75,6 +75,35 @@ def mix32(x: np.ndarray, seed: int | np.uint32 = 0) -> np.ndarray:
     return x
 
 
+def ensure_uint32_keys(keys: np.ndarray) -> np.ndarray:
+    """Validate raw-width keys for the device-hash path; return them uint32.
+
+    The device-hash trainer truncates keys to uint32, so keys ``>= 2**32-1``
+    would silently wrap (or alias :data:`PAD_KEY32` and route to the trash
+    row), corrupting training with no error.  This enforces the documented
+    "< 2**32 - 1 unless PAD" contract: callers pass keys at their RAW width
+    (a caller-side ``astype(np.uint32)`` would wrap bad keys before the
+    check can see them — ADVICE r2), and this returns the validated uint32
+    array.  Already-uint32 input passes through untouched (the width itself
+    is the proof).  Shared by ``LocalLRTrainer.step_block`` and the prefetch
+    producer so pipelined ingest keeps the same guard.
+    """
+    keys = np.asarray(keys)
+    if keys.dtype == np.uint32:
+        return keys
+    kb = keys.astype(np.uint64)  # signed -1 coerces to PAD_KEY
+    # cheap scalar early-out: only blocks containing a suspicious key
+    # (>= uint32 max; PAD_KEY itself is uint64 max) pay for the mask
+    if int(kb.max(initial=0)) >= 0xFFFF_FFFF:
+        bad = (kb != PAD_KEY) & (kb >= np.uint64(0xFFFF_FFFF))
+        if bad.any():
+            raise ValueError(
+                "device-hash keys must be < 2**32 - 1 "
+                f"(or PAD_KEY); got {int(kb[bad][0])}"
+            )
+    return kb.astype(np.uint32)
+
+
 def bucket_size(n: int, *, min_bucket: int = 256) -> int:
     """Round ``n`` up to the next power-of-two bucket (>= min_bucket).
 

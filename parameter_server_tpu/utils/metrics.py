@@ -165,14 +165,25 @@ class Dashboard:
     tracer: Optional[object] = None
     #: optional Van (stacked wrappers fine): rows gain a ``net`` dict of
     #: cumulative transport counters — retransmits, dup_suppressed, gave_up,
-    #: injected chaos faults, sent/dropped (see :func:`transport_counters`).
+    #: injected chaos faults, sent/dropped (see :func:`transport_counters`)
+    #: plus derived wire-efficiency fields when a ``CoalescingVan`` is in
+    #: the stack: ``bundle_occupancy`` (sub-messages per bundle frame) and
+    #: ``frames_per_step`` (per-interval wire frames / iterations — the
+    #: number coalescing exists to shrink).
     transport: Optional[object] = None
+    #: optional ``data.prefetch.PrefetchPipeline`` (anything with
+    #: ``counters()``): rows gain a ``prefetch`` dict — produced/consumed
+    #: block counts and cumulative stall count/seconds (consumer time spent
+    #: waiting on the producer; nonzero means ingest is the bottleneck).
+    prefetch: Optional[object] = None
     _start: float = dataclasses.field(default_factory=time.time)
     _last_obj: Optional[float] = None
     _last_t: Optional[float] = None
     _examples: int = 0
     _header_printed: bool = False
     _attr_last: dict = dataclasses.field(default_factory=dict)
+    _net_sent_last: int = 0
+    _net_iter_last: int = -1
 
     def record(self, iteration: int, objective: float, extra: Optional[dict] = None,
                examples: int = 0) -> None:
@@ -209,7 +220,28 @@ class Dashboard:
         if self.transport is not None:
             net = transport_counters(self.transport)
             if net:
+                frames = net.get("coalesce_frames", 0)
+                if frames:
+                    net["bundle_occupancy"] = round(
+                        net.get("coalesce_msgs", 0) / frames, 2
+                    )
+                sent = net.get("sent")
+                if sent is not None:
+                    d_iter = iteration - self._net_iter_last
+                    if self._net_iter_last >= 0 and d_iter > 0:
+                        net["frames_per_step"] = round(
+                            (sent - self._net_sent_last) / d_iter, 2
+                        )
+                    self._net_sent_last = sent
+                    self._net_iter_last = iteration
                 row["net"] = net
+        if self.prefetch is not None:
+            pf_counters = getattr(self.prefetch, "counters", None)
+            if callable(pf_counters):
+                try:
+                    row["prefetch"] = pf_counters()
+                except Exception:  # pragma: no cover — metrics must never
+                    pass  # crash training
         printing = self.print_every and iteration % self.print_every == 0
         if self.tracer is not None and (printing or self.jsonl is not None):
             # interval DELTAS (this row's share), from the tracer's O(1)

@@ -368,6 +368,40 @@ def test_lr_training_under_5pct_drop_matches_clean_run(seed):
         van.close()
 
 
+@pytest.mark.parametrize("seed", [0, 1])
+def test_lr_training_coalesced_under_chaos_matches_clean_run(seed):
+    """The full wire plane: CoalescingVan OUTERMOST over the reliable+chaos
+    stack.  Bundles are stamped/retransmitted/deduplicated as units, so the
+    training trajectory is still bitwise the clean run's, the servers apply
+    exactly the clean number of pushes, and the run actually coalesced
+    (frames < sub-messages)."""
+    from parameter_server_tpu.core.coalesce import CoalescingVan
+
+    ref_losses, ref_applied = _clean_reference()
+
+    rel, chaos = _reliable_stack(
+        seed=seed, timeout=0.1, drop=0.05, duplicate=0.05
+    )
+    van = CoalescingVan(rel)
+    try:
+        servers = [
+            KVServer(Postoffice(f"S{s}", van), _table_cfgs(), s, NUM_SERVERS)
+            for s in range(NUM_SERVERS)
+        ]
+        worker = KVWorker(Postoffice("W0", van), _table_cfgs(), NUM_SERVERS)
+        losses = _train(worker, _batches())
+        np.testing.assert_allclose(losses, ref_losses, rtol=1e-7, atol=0)
+        assert sum(s.pushes for s in servers) == ref_applied  # exactly once
+        assert van.flush(10)  # drains own buffers AND waits for ACKs
+        assert rel.gave_up == 0
+        assert chaos.injected_drops + chaos.injected_dups > 0
+        c = van.counters()
+        assert c["coalesce_frames"] > 0
+        assert c["coalesce_msgs"] >= c["coalesce_frames"]
+    finally:
+        van.close()
+
+
 def test_lr_training_survives_server_kill_and_promotion_under_drop():
     """Acceptance: mid-run S0 kill + hot-standby promotion under 1% drop —
     training completes WITHOUT a checkpoint rewind, on the exact clean

@@ -30,6 +30,23 @@ def test_emb_plane_overlapped_small_shape():
     assert r["tokens_per_sec_overlapped"] > 0
 
 
+def test_emb_plane_overlapped_zero_body_measures_serial_plane():
+    """The sweep's t_body_s=0 run: no body window to hide behind, so the
+    record reports the plane's serial cost directly and the "% of a
+    zero-length body" ratio is None (not a division blowup or a fake 0)."""
+    if native.load("tcpvan") is None:  # pragma: no cover
+        pytest.skip("no native toolchain for tcpvan")
+    r = bench._emb_plane_overlapped(
+        VOCAB=16384, D=256, B=8, S=256, steps=3, t_body_s=0.0,
+        filters="key_caching+int8",
+    )
+    assert r["exposure_pct_of_body"] is None
+    assert r["t_body_ms"] == 0
+    assert np.all(np.isfinite(r["exposure_ms"]))
+    assert np.all(np.asarray(r["exposure_ms"]) >= 0)
+    assert r["tokens_per_sec_overlapped"] > 0
+
+
 def test_plane_codec_microbench_shape():
     c = bench._plane_codec_microbench(D=64, rows=500)
     assert c["payload_mb"] > 0
