@@ -63,6 +63,22 @@ PEAK_HBM_GBPS = {"tpu": 819.0, "cpu": 200.0}
 _EMIT_ONCE = threading.Lock()
 _EMITTED = False
 
+#: --trace-dir DIR: drop observability artifacts (per-phase chrome traces,
+#: merged Perfetto timeline, fleet JSONL) next to the BENCH_*.json record.
+TRACE_DIR = None
+
+
+def _arg_value(flag: str):
+    """Value of ``--flag VALUE`` or ``--flag=VALUE`` from sys.argv, or None
+    (this bench dispatches on raw sys.argv flags, not argparse)."""
+    argv = sys.argv[1:]
+    for i, a in enumerate(argv):
+        if a == flag and i + 1 < len(argv):
+            return argv[i + 1]
+        if a.startswith(flag + "="):
+            return a.split("=", 1)[1]
+    return None
+
 
 def _emit(obj: dict) -> None:
     """Print the one-and-only JSON result line (idempotent: the watchdog
@@ -242,6 +258,11 @@ def run_bench() -> tuple[dict, str]:
     from parameter_server_tpu.config import OptimizerConfig, TableConfig
     from parameter_server_tpu.data.synthetic import SyntheticCTR
     from parameter_server_tpu.learner.sgd import LocalLRTrainer
+    from parameter_server_tpu.utils.trace import NULL_TRACER, Tracer
+
+    # --trace-dir: record per-phase spans and export a chrome-trace timeline
+    # next to the JSON record; NULL_TRACER keeps the default path at zero cost
+    tracer = Tracer() if TRACE_DIR else NULL_TRACER
 
     backend = jax.default_backend()
     on_tpu = backend == "tpu"
@@ -336,6 +357,7 @@ def run_bench() -> tuple[dict, str]:
                 losses = trainer.step_block_device(kd, yd)
             jax.block_until_ready(losses)
             d = time.perf_counter() - t0
+            tracer.record("bench.pipelined_window", d, start_s=t0)
             c = pf.counters()
             prefetch_windows.append(
                 {
@@ -386,6 +408,9 @@ def run_bench() -> tuple[dict, str]:
             phase_acc["assemble_s"] += tb - ta
             phase_acc["h2d_s"] += tc - tb
             phase_acc["device_s"] += td - tc
+            tracer.record("bench.assemble", tb - ta, start_s=ta)
+            tracer.record("bench.h2d", tc - tb, start_s=tb)
+            tracer.record("bench.device", td - tc, start_s=tc)
             h2d_bytes_total += kb32.nbytes + yb.nbytes
         dt_fed = time.perf_counter() - t_start
         fed_dt_total += dt_fed
@@ -477,6 +502,13 @@ def run_bench() -> tuple[dict, str]:
             "peak_hbm_gbps": peak_hbm,
         },
     }
+    if TRACE_DIR:
+        os.makedirs(TRACE_DIR, exist_ok=True)
+        tracer.dump_chrome_trace(
+            os.path.join(TRACE_DIR, "bench_phases_trace.json"),
+            process_name="bench",
+        )
+        record["trace_dir"] = TRACE_DIR
     if errors:
         record["error"] = "; ".join(errors)
     diag = (
@@ -2461,7 +2493,115 @@ def record_anchor(record: dict, diag: str) -> None:
     )
 
 
+def emit_observability_artifacts(trace_dir: str) -> None:
+    """``--trace-dir`` side artifacts beyond the bench's own phase trace:
+    run a tiny 2-worker/2-server metered cluster and drop (a) per-node
+    chrome traces, (b) the merged cross-node Perfetto timeline
+    (``tools/merge_traces.py``), and (c) a fleet-monitor JSONL — the full
+    observability-plane demo next to the BENCH_*.json record (README
+    "Observability" documents the fields)."""
+    import importlib.util
+
+    from parameter_server_tpu.config import OptimizerConfig, TableConfig
+    from parameter_server_tpu.core.fleet import FleetMonitor
+    from parameter_server_tpu.core.manager import launch_local_cluster
+    from parameter_server_tpu.core.messages import (
+        SCHEDULER,
+        server_id,
+        worker_id,
+    )
+    from parameter_server_tpu.core.netmon import MeteredVan
+    from parameter_server_tpu.core.van import LoopbackVan
+    from parameter_server_tpu.kv.server import KVServer
+    from parameter_server_tpu.kv.worker import KVWorker
+    from parameter_server_tpu.utils.keys import HashLocalizer
+    from parameter_server_tpu.utils.trace import Tracer
+
+    os.makedirs(trace_dir, exist_ok=True)
+    nw = ns = 2
+    rows, dim = 1 << 10, 4
+    tables = {
+        "w": TableConfig(
+            name="w", rows=rows, dim=dim,
+            optimizer=OptimizerConfig(kind="sgd", learning_rate=0.1),
+        )
+    }
+    van = MeteredVan(LoopbackVan())
+    tracers: dict[str, "Tracer"] = {}
+    fleet_f = open(os.path.join(trace_dir, "fleet.jsonl"), "w")
+    try:
+        sched, managers, posts = launch_local_cluster(
+            van, num_workers=nw, num_servers=ns
+        )
+        fleet = FleetMonitor(jsonl=fleet_f)
+        sched.fleet = fleet
+        loc = {"w": HashLocalizer(rows)}
+        for i in range(ns):
+            sid = server_id(i)
+            tracers[sid] = Tracer()
+            KVServer(posts[sid], tables, i, ns, tracer=tracers[sid])
+        workers = {}
+        for i in range(nw):
+            wid = worker_id(i)
+            tracers[wid] = Tracer()
+            workers[wid] = KVWorker(
+                posts[wid], tables, ns,
+                localizers=loc, tracer=tracers[wid],
+            )
+        rng = np.random.default_rng(0)
+        for _ in range(3):  # a few push/pull rounds = trace + wire material
+            for w in workers.values():
+                keys = rng.integers(0, rows, size=64).astype(np.int64)
+                grads = rng.standard_normal((64, dim)).astype(np.float32)
+                w.wait(w.push("w", keys, grads))
+                w.pull_sync("w", keys)
+            for nid, mgr in managers.items():
+                if nid != SCHEDULER:
+                    mgr.send_heartbeat()
+            fleet.write_jsonl()
+        paths = []
+        for nid, tr in tracers.items():
+            p = os.path.join(trace_dir, f"trace_{nid}.json")
+            tr.dump_chrome_trace(p, process_name=nid)
+            paths.append(p)
+        # tools/ is not a package; load merge_traces straight off disk
+        mt_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "tools", "merge_traces.py",
+        )
+        spec = importlib.util.spec_from_file_location("merge_traces", mt_path)
+        mt = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mt)
+        merged = mt.merge_traces(paths)
+        with open(os.path.join(trace_dir, "merged_trace.json"), "w") as f:
+            json.dump(merged, f)
+        print(
+            f"observability artifacts in {trace_dir}: "
+            f"{len(paths)} node traces, merged_trace.json, fleet.jsonl",
+            file=sys.stderr,
+        )
+    finally:
+        fleet_f.close()
+        van.close()
+
+
 def main() -> None:
+    global TRACE_DIR
+    TRACE_DIR = _arg_value("--trace-dir")
+    try:
+        _dispatch()
+    finally:
+        if TRACE_DIR:
+            try:
+                emit_observability_artifacts(TRACE_DIR)
+            except Exception:  # noqa: BLE001 — artifacts must never fail
+                # the bench record (already emitted by _dispatch)
+                import traceback
+
+                traceback.print_exc(file=sys.stderr)
+
+
+def _dispatch() -> None:
     micro = "--micro" in sys.argv[1:]
     hybrid_mode = "--hybrid" in sys.argv[1:]
     crossover_mode = "--crossover" in sys.argv[1:]

@@ -430,20 +430,27 @@ def _build_async_lr(cfg: AppConfig) -> Callable[[], dict]:
     def run() -> dict:
         import numpy as np
 
+        from parameter_server_tpu.core.fleet import FleetMonitor
         from parameter_server_tpu.core.manager import launch_local_cluster
         from parameter_server_tpu.core.messages import server_id, worker_id
+        from parameter_server_tpu.core.netmon import MeteredVan
         from parameter_server_tpu.core.van import LoopbackVan
         from parameter_server_tpu.kv.server import KVServer
         from parameter_server_tpu.kv.worker import KVWorker
         from parameter_server_tpu.learner.elastic import ElasticTrainer
         from parameter_server_tpu.utils.keys import HashLocalizer
+        from parameter_server_tpu.utils.metrics import transport_counters
 
         nw, ns = cfg.topology.num_workers, cfg.topology.num_servers
-        van = LoopbackVan()
+        # metered outermost: per-link wire accounting on every logical
+        # message; heartbeats carry the digests to the scheduler's fleet
+        # monitor (SURVEY §5 observability plane)
+        van = MeteredVan(LoopbackVan())
         try:
             sched, managers, posts = launch_local_cluster(
                 van, num_workers=nw, num_servers=ns
             )
+            sched.fleet = FleetMonitor()
             tables = {cfg.table.name: cfg.table}
             loc = {cfg.table.name: HashLocalizer(cfg.table.rows)}
             _servers = {
@@ -479,6 +486,9 @@ def _build_async_lr(cfg: AppConfig) -> Callable[[], dict]:
                 "steps": len(losses),
                 "mean_loss_tail": float(np.mean(losses[-10:])),
                 "last_ckpt_step": trainer.last_ckpt_step,
+                "net": transport_counters(van),
+                "fleet": sched.fleet.snapshot(),
+                "stragglers": sched.fleet.stragglers(),
             }
         finally:
             van.close()

@@ -7,6 +7,7 @@ control-plane and DCN-plane traffic.
 
 from parameter_server_tpu.core.chaos import ChaosConfig, ChaosVan
 from parameter_server_tpu.core.coalesce import CoalescingVan
+from parameter_server_tpu.core.fleet import FleetMonitor, StragglerPolicy
 from parameter_server_tpu.core.messages import (
     Message,
     NodeRole,
@@ -15,6 +16,7 @@ from parameter_server_tpu.core.messages import (
     server_id,
     worker_id,
 )
+from parameter_server_tpu.core.netmon import MeteredVan
 from parameter_server_tpu.core.resender import ReliableVan
 from parameter_server_tpu.core.van import LoopbackVan, Van, VanWrapper
 
@@ -22,10 +24,13 @@ __all__ = [
     "ChaosConfig",
     "ChaosVan",
     "CoalescingVan",
+    "FleetMonitor",
     "LoopbackVan",
     "Message",
+    "MeteredVan",
     "NodeRole",
     "ReliableVan",
+    "StragglerPolicy",
     "Task",
     "TaskKind",
     "Van",

@@ -17,7 +17,16 @@ Van decorator that injects in-flight faults between ``send`` and delivery:
   link overtake this one;
 - **partition**: per-link blackholes, asymmetric by default (A can reach B
   while B cannot reach A — the split-brain shape ``disconnect`` cannot
-  model).
+  model);
+- **slow** (gray failure): a fixed extra delivery delay, per link
+  (``ChaosConfig.slow_ms``) or per NODE (:meth:`ChaosVan.slow_node` slows
+  every link INTO the node) — the slow-but-alive shape the ROADMAP names
+  as unmodeled.  A slowed node still heartbeats on time, so liveness
+  sweeps never fire; only per-link latency attribution
+  (``core/netmon.py`` -> ``core/fleet.py``) can see it.  Inbound-only by
+  design: a gray node's observable symptom is work queueing at ITS door,
+  and metering attributes deliver latency to the destination, so the
+  detector's signal lands on the right node.
 
 Determinism: every decision comes from a per-link ``random.Random`` keyed
 by ``(seed, sender, recver)`` via crc32, and exactly four uniforms are
@@ -66,16 +75,27 @@ class ChaosConfig:
     #: penalty added on a reorder hit (must exceed the link's typical
     #: inter-send gap to actually swap adjacent messages).
     reorder_delay: float = 0.01
+    #: gray failure: fixed extra delivery delay (milliseconds) on this
+    #: link.  Deterministic — no RNG draw — so a slowed link never shifts
+    #: the fault sequence of drop/dup/reorder decisions.
+    slow_ms: float = 0.0
 
     @property
-    def inert(self) -> bool:
-        return (
+    def randomized(self) -> bool:
+        """Any stochastic fault enabled — exactly these configs consume the
+        four per-message RNG draws, so adding ``slow_ms`` to a link can
+        never shift the seeded fault sequence of any other fault."""
+        return not (
             self.drop == 0.0
             and self.duplicate == 0.0
             and self.reorder == 0.0
             and self.delay == 0.0
             and self.jitter == 0.0
         )
+
+    @property
+    def inert(self) -> bool:
+        return not self.randomized and self.slow_ms == 0.0
 
 
 class TimerWheel:
@@ -173,9 +193,12 @@ class ChaosVan(VanWrapper):
         self.injected_drops = 0
         self.injected_dups = 0
         self.injected_reorders = 0
+        self.injected_slow = 0
         self.partition_drops = 0
         self.unreachable_drops = 0
         self.forwarded = 0
+        #: gray failures: node id -> extra inbound delivery delay (seconds).
+        self._slow: Dict[str, float] = {}
 
     # -- configuration -------------------------------------------------------
     def set_link(self, sender: str, recver: str, cfg: ChaosConfig) -> None:
@@ -203,6 +226,20 @@ class ChaosVan(VanWrapper):
             else:
                 self._partitions.discard((a, b))
 
+    # -- gray failures (slow-but-alive nodes) --------------------------------
+    def slow_node(self, node_id: str, slow_ms: float) -> None:
+        """Make ``node_id`` a gray failure: every delivery INTO it gains a
+        fixed ``slow_ms`` delay (0 heals).  Deterministic — no RNG draws —
+        so the seeded fault sequence of every other injector is unchanged.
+        The node itself stays alive and heartbeating; only the fleet
+        monitor's latency attribution can tell it apart from a healthy one.
+        """
+        with self._lock:
+            if slow_ms <= 0.0:
+                self._slow.pop(node_id, None)
+            else:
+                self._slow[node_id] = slow_ms / 1e3
+
     # -- send path -----------------------------------------------------------
     def _rng(self, link: Tuple[str, str]) -> random.Random:
         r = self._rngs.get(link)
@@ -220,10 +257,11 @@ class ChaosVan(VanWrapper):
                 self.partition_drops += 1
                 return True  # swallowed in flight
             cfg = self.links.get(link, self.default)
-            if cfg.inert:
-                pass_through = True
-            else:
-                pass_through = False
+            # gray-failure delay: per-node (slow_node) + per-link config;
+            # deterministic, consumes no draws
+            slow = self._slow.get(msg.recver, 0.0) + cfg.slow_ms / 1e3
+            randomized = cfg.randomized
+            if randomized:
                 # exactly four draws per message, config-independent, so a
                 # config tweak cannot shift the fault sequence of later sends
                 rng = self._rng(link)
@@ -231,7 +269,7 @@ class ChaosVan(VanWrapper):
                 u_dup = rng.random()
                 u_jit = rng.random()
                 u_reord = rng.random()
-        if pass_through:
+        if not randomized and slow == 0.0:
             ok = self.inner.send(msg)
             with self._lock:
                 if ok:
@@ -239,20 +277,25 @@ class ChaosVan(VanWrapper):
                 else:
                     self.unreachable_drops += 1
             return True
-        if u_drop < cfg.drop:
-            with self._lock:
-                self.injected_drops += 1
-            return True
         copies = 1
-        if u_dup < cfg.duplicate:
-            copies = 2
+        latency = slow
+        if randomized:
+            if u_drop < cfg.drop:
+                with self._lock:
+                    self.injected_drops += 1
+                return True
+            if u_dup < cfg.duplicate:
+                copies = 2
+                with self._lock:
+                    self.injected_dups += 1
+            latency += cfg.delay + u_jit * cfg.jitter
+            if u_reord < cfg.reorder:
+                latency += cfg.reorder_delay
+                with self._lock:
+                    self.injected_reorders += 1
+        if slow > 0.0:
             with self._lock:
-                self.injected_dups += 1
-        latency = cfg.delay + u_jit * cfg.jitter
-        if u_reord < cfg.reorder:
-            latency += cfg.reorder_delay
-            with self._lock:
-                self.injected_reorders += 1
+                self.injected_slow += 1
         if latency <= 0.0:
             # synchronous path: per-link FIFO preserved exactly (duplicates
             # arrive back to back, like an eager retransmitter)
@@ -285,6 +328,7 @@ class ChaosVan(VanWrapper):
                 "chaos_drops": self.injected_drops,
                 "chaos_dups": self.injected_dups,
                 "chaos_reorders": self.injected_reorders,
+                "chaos_slow": self.injected_slow,
                 "chaos_partition_drops": self.partition_drops,
                 "chaos_unreachable": self.unreachable_drops,
             }

@@ -1,0 +1,248 @@
+"""FleetMonitor: scheduler-side node time series + straggler detection.
+
+Reference analogue: ``heartbeat_info.h`` -> ``monitor.h`` -> ``dashboard.h``
+[U] — worker/server heartbeats carried CPU and network usage, the scheduler
+kept per-node rows and printed the fleet table.  Our Manager accepted those
+``stats`` payloads and dropped them; this module is where they land.
+
+The interesting detector is the GRAY-FAILURE one (ROADMAP names it as
+unmodeled).  A slow-but-alive node heartbeats on time, so the liveness
+sweep (``Manager.check_heartbeats``) never fires; what gives it away is
+latency: every link INTO it runs k× slower than the fleet.  Heartbeats
+auto-attach per-link deliver-latency digests
+(:meth:`~parameter_server_tpu.core.netmon.MeteredVan.node_digests`);
+FleetMonitor merges them into a per-node INBOUND histogram and flags nodes
+whose push p99 exceeds k× the fleet median — with an absolute floor so
+microsecond-scale jitter inside a uniformly healthy fleet can never trip
+it.  Heartbeat-GAP straggling (a node that reports, but late) is flagged
+the same relative way against the fleet's median beat interval.
+
+Wall-clock discipline: every entry point takes an explicit ``now``
+(``time.monotonic()`` domain) so tests drive synthetic clocks and the
+detector is deterministic under load.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import statistics
+import threading
+import time
+from typing import IO, Dict, List, Optional
+
+from parameter_server_tpu.utils.trace import LatencyHistogram
+
+
+@dataclasses.dataclass(frozen=True)
+class StragglerPolicy:
+    """Thresholds for the two detectors.  Both are RELATIVE (k× the fleet
+    median) with ABSOLUTE floors: relative-only would flag one node of a
+    uniformly fast fleet over microseconds of noise; absolute-only would
+    need retuning per deployment."""
+
+    #: flag when a node's stat exceeds k× the fleet median of that stat.
+    k: float = 4.0
+    #: inbound push p99 must also exceed this to flag (absolute floor).
+    p99_floor_ms: float = 10.0
+    #: heartbeat gap must also exceed this to flag (absolute floor).
+    gap_floor_s: float = 1.0
+    #: minimum inbound deliver samples before the latency detector speaks.
+    min_latency_count: int = 4
+    #: minimum heartbeats per node before the gap detector speaks.
+    min_heartbeats: int = 2
+
+
+class _NodeSeries:
+    """Retained per-node state: beat times + latest cumulative stats."""
+
+    __slots__ = ("beats", "resource", "prev_resource", "net", "prev_net")
+
+    def __init__(self, window: int) -> None:
+        import collections
+
+        self.beats: "collections.deque[float]" = collections.deque(
+            maxlen=window
+        )
+        self.resource: dict = {}
+        self.prev_resource: dict = {}
+        self.net: dict = {}
+        self.prev_net: dict = {}
+
+
+class FleetMonitor:
+    """Aggregates heartbeat stats into per-node series + straggler flags.
+
+    Attach to the scheduler's Manager (``sched.fleet = FleetMonitor()``);
+    ``Manager._on_heartbeat`` then feeds every beat's stats here.  Pass a
+    ``jsonl`` stream and each :meth:`write_jsonl` call appends one fleet
+    snapshot line (the ``fleet`` JSONL artifact — field meanings in the
+    README Observability section).
+    """
+
+    def __init__(
+        self,
+        *,
+        policy: Optional[StragglerPolicy] = None,
+        window: int = 256,
+        jsonl: Optional[IO[str]] = None,
+    ) -> None:
+        self.policy = policy or StragglerPolicy()
+        self.jsonl = jsonl
+        self._window = window
+        self._lock = threading.Lock()
+        self._series: Dict[str, _NodeSeries] = {}
+        #: latest CUMULATIVE per-link digest, keyed "sender->recver".
+        #: Cumulative digests are REPLACED, never re-merged — merging two
+        #: snapshots of the same counter would double-count every sample.
+        self._links: Dict[str, dict] = {}
+
+    # -- ingest --------------------------------------------------------------
+    def observe(
+        self, node_id: str, stats: dict, now: Optional[float] = None
+    ) -> None:
+        """Record one heartbeat's stats payload from ``node_id``."""
+        now = time.monotonic() if now is None else now
+        stats = stats or {}
+        with self._lock:
+            s = self._series.get(node_id)
+            if s is None:
+                s = self._series[node_id] = _NodeSeries(self._window)
+            s.beats.append(now)
+            if stats.get("resource"):
+                s.prev_resource, s.resource = s.resource, dict(stats["resource"])
+            if stats.get("net"):
+                s.prev_net, s.net = s.net, dict(stats["net"])
+            for link, digest in (stats.get("links") or {}).items():
+                self._links[link] = digest
+
+    def nodes(self) -> List[str]:
+        with self._lock:
+            return sorted(self._series)
+
+    # -- derived stats -------------------------------------------------------
+    @staticmethod
+    def _inbound_hist(links: Dict[str, dict], node_id: str) -> LatencyHistogram:
+        """Merged deliver-latency histogram of every link INTO a node.
+
+        Safe to merge: each link digest appears exactly once in ``links``
+        (latest snapshot), and distinct links are independent streams.
+        """
+        h = LatencyHistogram()
+        for link, digest in links.items():
+            if link.endswith(f"->{node_id}") and digest.get("deliver"):
+                h.merge(LatencyHistogram.from_dict(digest["deliver"]))
+        return h
+
+    def snapshot(self, now: Optional[float] = None) -> Dict[str, dict]:
+        """Per-node derived rows: beat cadence, rates, inbound latency."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            series = dict(self._series)
+            links = dict(self._links)
+        out: Dict[str, dict] = {}
+        for node_id, s in series.items():
+            beats = list(s.beats)
+            row: dict = {
+                "heartbeats": len(beats),
+                "last_seen_s": round(now - beats[-1], 3) if beats else None,
+            }
+            if len(beats) >= 2:
+                gaps = [b - a for a, b in zip(beats, beats[1:])]
+                row["beat_interval_s"] = round(statistics.median(gaps), 3)
+            res, prev = s.resource, s.prev_resource
+            if res:
+                if "rss_mb" in res:
+                    row["rss_mb"] = round(res["rss_mb"], 1)
+                dt = res.get("time", 0.0) - prev.get("time", 0.0)
+                if prev and dt > 0 and "cpu_user_s" in res:
+                    busy = (
+                        res.get("cpu_user_s", 0.0) + res.get("cpu_sys_s", 0.0)
+                        - prev.get("cpu_user_s", 0.0) - prev.get("cpu_sys_s", 0.0)
+                    )
+                    row["cpu_pct"] = round(100.0 * busy / dt, 1)
+            net, pnet = s.net, s.prev_net
+            if net and pnet and len(beats) >= 2:
+                dt = beats[-1] - beats[-2]
+                if dt > 0 and "wire_bytes" in net:
+                    row["wire_bytes_per_s"] = round(
+                        (net["wire_bytes"] - pnet.get("wire_bytes", 0)) / dt, 1
+                    )
+            h = self._inbound_hist(links, node_id)
+            if h.count:
+                row["push_p99_ms"] = round(1e3 * h.percentile(0.99), 3)
+                row["push_p50_ms"] = round(1e3 * h.percentile(0.50), 3)
+                row["inbound_count"] = h.count
+            out[node_id] = row
+        return out
+
+    # -- detection -----------------------------------------------------------
+    def stragglers(self, now: Optional[float] = None) -> Dict[str, List[str]]:
+        """Nodes currently flagged, with human-readable reasons.
+
+        Empty dict = healthy fleet.  Needs >= 2 reporting nodes — "k× the
+        fleet median" is meaningless for a fleet of one.
+        """
+        now = time.monotonic() if now is None else now
+        pol = self.policy
+        flags: Dict[str, List[str]] = {}
+        with self._lock:
+            series = dict(self._series)
+            links = dict(self._links)
+        if len(series) < 2:
+            return flags
+
+        # gray failures: inbound push p99 vs fleet median
+        p99s = {}
+        for node_id in series:
+            h = self._inbound_hist(links, node_id)
+            if h.count >= pol.min_latency_count:
+                p99s[node_id] = h.percentile(0.99)
+        if len(p99s) >= 2:
+            med = statistics.median(p99s.values())
+            for node_id, p99 in p99s.items():
+                if p99 > pol.k * med and p99 * 1e3 > pol.p99_floor_ms:
+                    flags.setdefault(node_id, []).append(
+                        f"inbound push p99 {p99 * 1e3:.1f}ms > "
+                        f"{pol.k:g}x fleet median {med * 1e3:.1f}ms"
+                    )
+
+        # heartbeat-gap stragglers: silence vs fleet median beat interval
+        intervals = {}
+        for node_id, s in series.items():
+            beats = list(s.beats)
+            if len(beats) >= pol.min_heartbeats:
+                gaps = [b - a for a, b in zip(beats, beats[1:])]
+                if gaps:
+                    intervals[node_id] = statistics.median(gaps)
+        if len(intervals) >= 2:
+            med = statistics.median(intervals.values())
+            for node_id, s in series.items():
+                if node_id not in intervals or not s.beats:
+                    continue
+                gap = now - s.beats[-1]
+                if gap > pol.k * max(med, 1e-9) and gap > pol.gap_floor_s:
+                    flags.setdefault(node_id, []).append(
+                        f"heartbeat silent {gap:.2f}s > {pol.k:g}x fleet "
+                        f"median interval {med:.2f}s"
+                    )
+        return flags
+
+    # -- JSONL sink ----------------------------------------------------------
+    def write_jsonl(self, now: Optional[float] = None) -> Optional[dict]:
+        """Append one fleet snapshot line to the attached ``jsonl`` stream.
+
+        Returns the row (or None without a sink).  Call per monitor sweep;
+        one line = one fleet-wide observation, replayable offline.
+        """
+        if self.jsonl is None:
+            return None
+        now = time.monotonic() if now is None else now
+        row = {
+            "t": time.time(),
+            "nodes": self.snapshot(now),
+            "stragglers": self.stragglers(now),
+        }
+        self.jsonl.write(json.dumps(row) + "\n")
+        self.jsonl.flush()
+        return row

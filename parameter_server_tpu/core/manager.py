@@ -19,6 +19,7 @@ and the WorkloadPool, which XLA/jax.distributed does not give you.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import threading
 import time
 from typing import Callable, Dict, List, Optional
@@ -122,6 +123,9 @@ class Manager(Customer):
         self.on_node_added: List[Callable[[str], None]] = []
         self._monitor_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        #: scheduler-side sink for heartbeat stats (attach a
+        #: ``core.fleet.FleetMonitor``); None = stats dropped as before.
+        self.fleet = None
         if self.role == NodeRole.SCHEDULER:
             self._register_self()
 
@@ -374,6 +378,15 @@ class Manager(Customer):
             cb(dead)
 
     def _on_heartbeat(self, msg: Message) -> None:
+        fleet = self.fleet
+        if fleet is not None:
+            try:
+                fleet.observe(msg.sender, msg.task.payload.get("stats") or {})
+            except Exception:  # noqa: BLE001 — monitoring must never break
+                # liveness handling (a malformed stats dict is not a death)
+                logging.getLogger(__name__).exception(
+                    "fleet: bad heartbeat stats from %s", msg.sender
+                )
         recovered = None
         with self._table_lock:
             n = self._table.get(msg.sender)
@@ -396,15 +409,52 @@ class Manager(Customer):
                 cb(msg.sender)
 
     # -- heartbeats / failure detection --------------------------------------
-    def send_heartbeat(self, stats: Optional[dict] = None) -> int:
-        """Non-scheduler: report liveness (+ optional resource stats)."""
+    def send_heartbeat(
+        self, stats: Optional[dict] = None, *, auto: bool = True
+    ) -> int:
+        """Non-scheduler: report liveness + observability stats.
+
+        ``auto=True`` (default) attaches what the reference carried in
+        ``heartbeat_info.h`` [U] and what the scheduler's
+        :class:`~parameter_server_tpu.core.fleet.FleetMonitor` consumes:
+        ``resource`` (:func:`~parameter_server_tpu.utils.trace.resource_usage`),
+        ``net`` (cumulative :func:`~parameter_server_tpu.utils.metrics.transport_counters`
+        of this node's Van stack), and ``links`` (per-link wire digests from
+        a :class:`~parameter_server_tpu.core.netmon.MeteredVan`, when one is
+        in the stack).  Caller-provided ``stats`` keys win (``setdefault``);
+        ``auto=False`` sends a bare liveness ping.  Stat collection failures
+        are swallowed — metrics must never cost a heartbeat.
+        """
+        payload_stats = dict(stats or {})
+        if auto:
+            try:
+                from parameter_server_tpu.core.netmon import find_metered
+                from parameter_server_tpu.utils.metrics import (
+                    transport_counters,
+                )
+                from parameter_server_tpu.utils.trace import resource_usage
+
+                payload_stats.setdefault("resource", resource_usage())
+                payload_stats.setdefault(
+                    "net", transport_counters(self.post.van)
+                )
+                metered = find_metered(self.post.van)
+                if metered is not None:
+                    payload_stats.setdefault(
+                        "links", metered.node_digests(self.post.node_id)
+                    )
+            except Exception:  # noqa: BLE001 — liveness > observability
+                logging.getLogger(__name__).exception(
+                    "heartbeat: stat collection failed on %s",
+                    self.post.node_id,
+                )
         return self.submit(
             [
                 Message(
                     task=Task(
                         TaskKind.CONTROL,
                         self.name,
-                        payload={"cmd": HEARTBEAT, "stats": stats or {}},
+                        payload={"cmd": HEARTBEAT, "stats": payload_stats},
                     ),
                     recver=SCHEDULER,
                 )

@@ -1,0 +1,201 @@
+"""MeteredVan: per-link wire accounting for any Van stack.
+
+Reference analogue: ``system/network_usage.h`` feeding ``monitor.h`` [U] —
+the per-node send/recv byte counters the scheduler dashboard aggregated.
+Here the accounting is a Van decorator, so it meters whatever stack it
+wraps: per directed link (sender -> recver) it records message counts,
+payload bytes (keys + values nbytes), and two latency distributions in
+mergeable :class:`~parameter_server_tpu.utils.trace.LatencyHistogram`\\ s:
+
+- **send**: the wall time of the inner ``send`` call (serialization,
+  filter passes, queue handoff — what the sending thread pays);
+- **deliver**: send-stamp to receive-side delivery, measured by stamping
+  ``time.monotonic()`` into ``Task.payload`` on the way out and reading it
+  in a receive wrapper on the way in (the ``__rseq__`` pattern of
+  ``core/resender.py``).  Over an in-process Van both ends share a clock,
+  so this is true one-way latency; cross-host it inherits clock skew like
+  every one-way measurement does.
+
+Stack position: OUTERMOST — ``MeteredVan(ReliableVan(ChaosVan(base)))`` —
+so each LOGICAL message is counted exactly once (retransmits, ACKs, and
+coalesced bundle frames happen in the layers below) and deliver latency
+includes everything the stack added: chaos delays, retransmit waits,
+bundle flushes.  That end-to-end per-link signal is what the
+``core/fleet.py`` straggler detector consumes: a gray-failing node shows
+up as elevated deliver latency on every link INTO it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from parameter_server_tpu.core.messages import Message
+from parameter_server_tpu.core.van import Van, VanWrapper
+from parameter_server_tpu.utils.trace import LatencyHistogram
+
+#: payload key carrying the send-side monotonic stamp (stripped on receive).
+STAMP_KEY = "__mts__"
+
+
+def payload_nbytes(msg: Message) -> int:
+    """Payload bytes of one message: keys nbytes + each value's nbytes.
+
+    ``nbytes`` is read straight off array values (numpy and jax.Array both
+    expose it — no device sync); anything else is sized via ``np.asarray``.
+    Task metadata (pickle overhead, payload dict) is intentionally NOT
+    counted: the meter reports the tensor traffic the PS exists to move,
+    which is what ``bytes_per_example`` should be built from.
+    """
+    total = 0
+    if msg.keys is not None:
+        total += int(msg.keys.nbytes)
+    for v in msg.values:
+        nb = getattr(v, "nbytes", None)
+        if nb is None:
+            nb = np.asarray(v).nbytes
+        total += int(nb)
+    return total
+
+
+class _LinkStats:
+    """Counters + histograms for one directed link."""
+
+    __slots__ = ("msgs", "bytes", "send", "deliver")
+
+    def __init__(self) -> None:
+        self.msgs = 0
+        self.bytes = 0
+        self.send = LatencyHistogram()
+        self.deliver = LatencyHistogram()
+
+
+class MeteredVan(VanWrapper):
+    """Wire-accounting Van decorator.  See module docstring.
+
+    ``stamp=False`` disables the payload timestamp (and with it deliver
+    latency) for stacks whose messages must round-trip byte-identical.
+    """
+
+    def __init__(self, inner: Van, *, stamp: bool = True) -> None:
+        super().__init__(inner)
+        self._stamp = stamp
+        self._lock = threading.Lock()
+        self._links: Dict[Tuple[str, str], _LinkStats] = {}
+        self.undeliverable = 0
+
+    def _link(self, sender: str, recver: str) -> _LinkStats:
+        st = self._links.get((sender, recver))
+        if st is None:
+            st = self._links[(sender, recver)] = _LinkStats()
+        return st
+
+    # -- send path -----------------------------------------------------------
+    def send(self, msg: Message) -> bool:
+        nbytes = payload_nbytes(msg)
+        out = msg
+        if self._stamp:
+            out = dataclasses.replace(
+                msg,
+                task=dataclasses.replace(
+                    msg.task,
+                    payload={**msg.task.payload, STAMP_KEY: time.monotonic()},
+                ),
+            )
+        t0 = time.perf_counter()
+        ok = self.inner.send(out)
+        dt = time.perf_counter() - t0
+        with self._lock:
+            st = self._link(msg.sender, msg.recver)
+            st.msgs += 1
+            st.bytes += nbytes
+            st.send.record(dt)
+            if not ok:
+                self.undeliverable += 1
+        return ok
+
+    # -- receive path --------------------------------------------------------
+    def bind(self, node_id: str, handler: Callable[[Message], None]) -> None:
+        def metered(msg: Message) -> None:
+            payload = msg.task.payload
+            ts = payload.get(STAMP_KEY) if isinstance(payload, dict) else None
+            if ts is not None:
+                # strip the stamp before delivery: replies share the Task
+                # (msg.reply()), so a leaked stamp would time-travel into
+                # the response leg and read as a negative latency
+                msg = dataclasses.replace(
+                    msg,
+                    task=dataclasses.replace(
+                        msg.task,
+                        payload={
+                            k: v for k, v in payload.items() if k != STAMP_KEY
+                        },
+                    ),
+                )
+                with self._lock:
+                    self._link(msg.sender, msg.recver).deliver.record(
+                        time.monotonic() - ts
+                    )
+            handler(msg)
+
+        self.inner.bind(node_id, metered)
+
+    # -- accounting ----------------------------------------------------------
+    def counters(self) -> dict:
+        """Numeric totals for the ``transport_counters`` merge walk."""
+        with self._lock:
+            return {
+                "wire_msgs": sum(st.msgs for st in self._links.values()),
+                "wire_bytes": sum(st.bytes for st in self._links.values()),
+                "wire_links": len(self._links),
+                "wire_undeliverable": self.undeliverable,
+            }
+
+    def links(self) -> Dict[str, dict]:
+        """Per-link digests keyed ``"sender->recver"`` (JSON-safe)."""
+        with self._lock:
+            return {
+                f"{s}->{r}": {
+                    "msgs": st.msgs,
+                    "bytes": st.bytes,
+                    "send": st.send.to_dict(),
+                    "deliver": st.deliver.to_dict(),
+                }
+                for (s, r), st in self._links.items()
+            }
+
+    def node_digests(self, node_id: str) -> Dict[str, dict]:
+        """The links ``node_id`` originated — its heartbeat contribution.
+
+        Each node reports only what IT sent; deliver histograms for those
+        links (recorded receive-side) ride along, so the fleet monitor can
+        attribute inbound latency to each link's DESTINATION without any
+        node reporting twice.
+        """
+        with self._lock:
+            return {
+                f"{s}->{r}": {
+                    "msgs": st.msgs,
+                    "bytes": st.bytes,
+                    "send": st.send.to_dict(),
+                    "deliver": st.deliver.to_dict(),
+                }
+                for (s, r), st in self._links.items()
+                if s == node_id
+            }
+
+
+def find_metered(van) -> Optional[MeteredVan]:
+    """First MeteredVan in a wrapper stack (``.inner`` walk), or None."""
+    seen = set()
+    v = van
+    while v is not None and id(v) not in seen:
+        seen.add(id(v))
+        if isinstance(v, MeteredVan):
+            return v
+        v = getattr(v, "inner", None)
+    return None

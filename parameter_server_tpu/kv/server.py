@@ -142,6 +142,13 @@ class KVServer(Customer):
             return self._handle_control(msg)
         tname = msg.task.payload["table"]
         table = self.tables[tname]
+        # cross-node stitching: echo the worker's trace context onto this
+        # handler's spans so merge_traces can pair both ends of the request
+        tctx = msg.task.payload.get("__trace__") or {}
+        span_attrs = {"table": tname}
+        if tctx.get("tid"):
+            span_attrs["trace"] = tctx["tid"]
+            span_attrs["origin"] = tctx.get("origin")
         # Bucket-pad the slice to a power of two: the worker bucket-pads its
         # unique slots, but the per-server split (Parameter::Slice) produces
         # arbitrary lengths again — without this every distinct length
@@ -165,7 +172,7 @@ class KVServer(Customer):
                     padded = np.zeros((b,) + vals.shape[1:], dtype=vals.dtype)
                     padded[:n] = vals
                     vals = padded
-            with self.tracer.span("kv.server.push", table=tname):
+            with self.tracer.span("kv.server.push", **span_attrs):
                 table.push(ids, jnp.asarray(vals))
             self.pushes += 1
             if self.replica is not None:
@@ -175,7 +182,7 @@ class KVServer(Customer):
                 self._forward_push(tname, msg)
             return msg.reply()
         elif msg.task.kind == TaskKind.PULL:
-            with self.tracer.span("kv.server.pull", table=tname):
+            with self.tracer.span("kv.server.pull", **span_attrs):
                 rows = table.pull(ids)
             self.pulls += 1
             if self.device_replies:

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import contextlib
 import functools
+import itertools
 from typing import Dict, Optional, Tuple
 
 import jax
@@ -78,6 +79,24 @@ class KVWorker(Customer):
         #: deadline-retry counters (surfaced next to transport counters)
         self.pull_retries = 0
         self.push_retries = 0
+        #: cross-node trace ids (see :meth:`_trace_ctx`)
+        self._trace_seq = itertools.count()
+
+    def _trace_ctx(self) -> dict:
+        """Fresh trace context for one logical request.
+
+        Stamped into ``Task.payload["__trace__"]`` of every wire leg and
+        recorded as a ``trace`` attr on this worker's span; KVServer echoes
+        it onto its handler spans, so ``tools/merge_traces.py`` can line up
+        a worker's ``kv.push`` with the serving nodes' ``kv.server.push``
+        on one merged timeline.  The id is unique per (node, customer,
+        request) — no coordination needed across nodes.
+        """
+        return {
+            "tid": f"{self.post.node_id}/{self.name}/{next(self._trace_seq)}",
+            "origin": self.post.node_id,
+            "customer": self.name,
+        }
 
     # -- push ---------------------------------------------------------------
     def push(self, table: str, keys: np.ndarray, values: np.ndarray) -> int:
@@ -86,7 +105,10 @@ class KVWorker(Customer):
         ``values`` has shape ``[len(keys), dim]`` (or ``[len(keys)]`` for
         dim=1 tables).
         """
-        with self.tracer.span("kv.push", table=table, n=int(keys.size)):
+        tctx = self._trace_ctx()
+        with self.tracer.span(
+            "kv.push", table=table, n=int(keys.size), trace=tctx["tid"]
+        ):
             cfg = self.table_cfgs[table]
             vals = np.asarray(values, dtype=cfg.dtype).reshape(keys.size, cfg.dim)
             slots, inverse, _n = localize_to_slots(
@@ -103,7 +125,9 @@ class KVWorker(Customer):
                 msgs.append(
                     Message(
                         task=Task(
-                            TaskKind.PUSH, self.name, payload={"table": table}
+                            TaskKind.PUSH,
+                            self.name,
+                            payload={"table": table, "__trace__": tctx},
                         ),
                         recver=server_id(s),
                         keys=local,
@@ -128,7 +152,10 @@ class KVWorker(Customer):
         """
         import jax.numpy as jnp  # local alias keeps the hot path explicit
 
-        with self.tracer.span("kv.push", table=table, n=int(keys.size)):
+        tctx = self._trace_ctx()
+        with self.tracer.span(
+            "kv.push", table=table, n=int(keys.size), trace=tctx["tid"]
+        ):
             cfg = self.table_cfgs[table]
             vals = values.reshape(keys.size, cfg.dim)
             slots, inverse, _n = localize_to_slots(
@@ -142,7 +169,9 @@ class KVWorker(Customer):
                 msgs.append(
                     Message(
                         task=Task(
-                            TaskKind.PUSH, self.name, payload={"table": table}
+                            TaskKind.PUSH,
+                            self.name,
+                            payload={"table": table, "__trace__": tctx},
                         ),
                         recver=server_id(s),
                         keys=local,
@@ -190,13 +219,18 @@ class KVWorker(Customer):
         return self._submit_pull(table, slots, inverse, keys.shape)
 
     def _submit_pull(self, table, slots, inverse, shape) -> int:
+        tctx = self._trace_ctx()
         msgs = []
         order = {}
         for s, seg, local in self.partitions[table].slice_ids(slots):
             order[server_id(s)] = seg
             msgs.append(
                 Message(
-                    task=Task(TaskKind.PULL, self.name, payload={"table": table}),
+                    task=Task(
+                        TaskKind.PULL,
+                        self.name,
+                        payload={"table": table, "__trace__": tctx},
+                    ),
                     recver=server_id(s),
                     keys=local,
                 )
@@ -211,6 +245,7 @@ class KVWorker(Customer):
             "table": table,
             # retained so a deadline retry can re-issue the identical pull
             "slots": slots,
+            "trace": tctx["tid"],
         }
         return ts
 
@@ -220,7 +255,8 @@ class KVWorker(Customer):
 
         Returns ``(ts, plan, responses)`` with all kept state drained.
         """
-        with self.tracer.span("kv.pull.wait", ts=ts):
+        tid = self._pull_plans[ts].get("trace")
+        with self.tracer.span("kv.pull.wait", ts=ts, trace=tid):
             completed = self.wait(ts, timeout)
         if not completed and self.retry_on_timeout:
             plan = self._pull_plans.pop(ts)
@@ -230,7 +266,8 @@ class KVWorker(Customer):
             ts = self._submit_pull(
                 plan["table"], plan["slots"], plan["inverse"], plan["shape"]
             )
-            with self.tracer.span("kv.pull.wait", ts=ts, retry=1):
+            tid = self._pull_plans[ts].get("trace")
+            with self.tracer.span("kv.pull.wait", ts=ts, retry=1, trace=tid):
                 completed = self.wait(ts, timeout)
         plan = self._pull_plans.pop(ts)  # always reclaim, even on error paths
         errs = self.errors(ts)

@@ -175,14 +175,14 @@ class ElasticTrainer:
         death signal the scheduler sweep detects.
         """
         from parameter_server_tpu.core.messages import SCHEDULER
-        from parameter_server_tpu.utils.trace import resource_usage
 
         while not stop.wait(self.heartbeat_interval):
-            stats = resource_usage()  # reference heartbeats carry CPU/mem [U]
             for nid, mgr in self.managers.items():
                 if nid == SCHEDULER or nid in self._killed:
                     continue
-                mgr.send_heartbeat(stats)
+                # auto-stats attach resource usage + wire digests, feeding
+                # the scheduler's FleetMonitor when one is installed
+                mgr.send_heartbeat()
 
     def _worker_loop(self, wid: str, kv: KVWorker, poll: float) -> None:
         idx = self._index[wid]

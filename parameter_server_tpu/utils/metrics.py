@@ -46,10 +46,10 @@ def transport_counters(van) -> dict:
     """Merge dashboard counters from a (possibly wrapped) Van stack.
 
     Walks the ``.inner`` chain of Van decorators (``ReliableVan``,
-    ``ChaosVan``) down to the base transport, merging each layer's
-    ``counters()`` dict — so retransmit / dup-suppressed / gave-up /
-    injected-fault counts ride next to sent/dropped in one flat dict.
-    Same-named keys across layers are summed.
+    ``ChaosVan``, ``MeteredVan``) down to the base transport, merging each
+    layer's ``counters()`` dict — so retransmit / dup-suppressed / gave-up
+    / injected-fault / wire-byte counts ride next to sent/dropped in one
+    flat dict.  Same-named keys across layers are summed.
     """
     out: dict = {}
     seen = set()
@@ -169,7 +169,10 @@ class Dashboard:
     #: plus derived wire-efficiency fields when a ``CoalescingVan`` is in
     #: the stack: ``bundle_occupancy`` (sub-messages per bundle frame) and
     #: ``frames_per_step`` (per-interval wire frames / iterations — the
-    #: number coalescing exists to shrink).
+    #: number coalescing exists to shrink).  With a ``MeteredVan`` in the
+    #: stack, rows also carry ``bytes_per_example`` (cumulative wire bytes
+    #: / examples trained — the wire cost of progress) and
+    #: ``wire_bytes_per_sec`` (per-interval link throughput).
     transport: Optional[object] = None
     #: optional ``data.prefetch.PrefetchPipeline`` (anything with
     #: ``counters()``): rows gain a ``prefetch`` dict — produced/consumed
@@ -184,6 +187,8 @@ class Dashboard:
     _attr_last: dict = dataclasses.field(default_factory=dict)
     _net_sent_last: int = 0
     _net_iter_last: int = -1
+    _net_bytes_last: int = 0
+    _net_t_last: Optional[float] = None
 
     def record(self, iteration: int, objective: float, extra: Optional[dict] = None,
                examples: int = 0) -> None:
@@ -234,6 +239,22 @@ class Dashboard:
                         )
                     self._net_sent_last = sent
                     self._net_iter_last = iteration
+                wire_bytes = net.get("wire_bytes")
+                if wire_bytes is not None:
+                    # wire efficiency next to examples_per_sec: cumulative
+                    # bytes per trained example + per-interval throughput
+                    if self._examples:
+                        net["bytes_per_example"] = round(
+                            wire_bytes / self._examples, 2
+                        )
+                    if self._net_t_last is not None:
+                        net["wire_bytes_per_sec"] = round(
+                            (wire_bytes - self._net_bytes_last)
+                            / max(now - self._net_t_last, 1e-9),
+                            1,
+                        )
+                    self._net_bytes_last = wire_bytes
+                    self._net_t_last = now
                 row["net"] = net
         if self.prefetch is not None:
             pf_counters = getattr(self.prefetch, "counters", None)

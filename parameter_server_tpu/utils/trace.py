@@ -17,6 +17,7 @@ from __future__ import annotations
 import collections
 import contextlib
 import json
+import math
 import os
 import threading
 import time
@@ -26,16 +27,129 @@ from typing import Dict, Iterator, List, Optional, Tuple
 Span = Tuple[str, float, float, int, Optional[dict]]
 
 
+class LatencyHistogram:
+    """O(1) mergeable log-bucketed streaming duration histogram.
+
+    Buckets are geometric: bucket ``i`` has upper edge ``BASE * GROWTH**i``
+    (bucket 0 holds everything <= 1 us); 96 buckets reach ~27 minutes at
+    <= 25% relative error — the right resolution for wire and handler
+    latencies.  Unlike the Tracer's bounded span deque this NEVER drops
+    history: count/sum/max are exact, percentiles are bucket-resolution
+    upper bounds (clamped to the observed max, so ``p99 <= max`` always).
+    Two histograms merge by adding bucket counts, which is what lets
+    per-link digests ride heartbeats and be re-aggregated fleet-side
+    (the reference monitor merged per-node ``network_usage`` the same way).
+
+    No internal lock: recorders (Tracer, MeteredVan) already serialize
+    under their own locks, and every mutation is a single GIL-atomic
+    scalar op, so a concurrent read can only skew a snapshot, never
+    corrupt state.
+    """
+
+    BASE = 1e-6
+    GROWTH = 1.25
+    NBUCKETS = 96
+    _LOG_G = math.log(GROWTH)
+
+    __slots__ = ("counts", "count", "sum_s", "max_s")
+
+    def __init__(self) -> None:
+        self.counts = [0] * self.NBUCKETS
+        self.count = 0
+        self.sum_s = 0.0
+        self.max_s = 0.0
+
+    def _bucket(self, seconds: float) -> int:
+        if seconds <= self.BASE:
+            return 0
+        return min(
+            self.NBUCKETS - 1,
+            1 + int(math.log(seconds / self.BASE) / self._LOG_G),
+        )
+
+    def record(self, seconds: float) -> None:
+        seconds = max(float(seconds), 0.0)
+        self.counts[self._bucket(seconds)] += 1
+        self.count += 1
+        self.sum_s += seconds
+        if seconds > self.max_s:
+            self.max_s = seconds
+
+    def merge(self, other: "LatencyHistogram") -> "LatencyHistogram":
+        """Add ``other``'s mass into this histogram (returns self)."""
+        for i, c in enumerate(other.counts):
+            self.counts[i] += c
+        self.count += other.count
+        self.sum_s += other.sum_s
+        self.max_s = max(self.max_s, other.max_s)
+        return self
+
+    def percentile(self, p: float) -> float:
+        """Upper bound (seconds) of the bucket holding the p-quantile."""
+        if self.count == 0:
+            return 0.0
+        target = max(1, math.ceil(p * self.count))
+        cum = 0
+        for i, c in enumerate(self.counts):
+            cum += c
+            if cum >= target:
+                return min(self.BASE * self.GROWTH**i, self.max_s)
+        return self.max_s  # pragma: no cover — cum == count by construction
+
+    def stats(self) -> dict:
+        """The Tracer.histogram row shape (count / mean / p50 / p99 / max)."""
+        if self.count == 0:
+            return {"count": 0}
+        return {
+            "count": self.count,
+            "total_s": self.sum_s,
+            "mean_us": 1e6 * self.sum_s / self.count,
+            "p50_us": 1e6 * self.percentile(0.50),
+            "p90_us": 1e6 * self.percentile(0.90),
+            "p99_us": 1e6 * self.percentile(0.99),
+            "max_us": 1e6 * self.max_s,
+        }
+
+    # -- wire form (heartbeat digests are JSON) ------------------------------
+    def to_dict(self) -> dict:
+        """JSON-safe digest; sparse buckets keep heartbeats small."""
+        return {
+            "count": self.count,
+            "sum_s": self.sum_s,
+            "max_s": self.max_s,
+            "b": {str(i): c for i, c in enumerate(self.counts) if c},
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "LatencyHistogram":
+        h = cls()
+        h.count = int(d.get("count", 0))
+        h.sum_s = float(d.get("sum_s", 0.0))
+        h.max_s = float(d.get("max_s", 0.0))
+        for i, c in (d.get("b") or {}).items():
+            h.counts[int(i)] = int(c)
+        return h
+
+
 class Tracer:
-    """Thread-safe span recorder with bounded memory."""
+    """Thread-safe span recorder: bounded timeline + unbounded histograms.
+
+    Two stores per span name, updated together under one lock:
+
+    - a bounded deque of full spans (timelines / chrome-trace export) —
+      oldest spans drop past ``capacity``;
+    - a :class:`LatencyHistogram` that never drops, so
+      :meth:`histogram` percentiles cover the whole run, not a silent
+      recent window (they used to be computed over the deque: after 100k
+      spans wrapped, "p99" quietly became "p99 of the last 100k").
+    """
 
     def __init__(self, *, capacity: int = 100_000, enabled: bool = True) -> None:
         self.enabled = enabled
         self._spans: collections.deque[Span] = collections.deque(maxlen=capacity)
-        #: O(1)-maintained per-name duration sums — unlike the bounded span
-        #: deque these never drop history, so dashboards can poll cheap
-        #: cumulative attribution without scanning (Dashboard.attribution).
-        self._totals: Dict[str, float] = {}
+        #: never-dropping per-name latency histograms (histogram/summary/
+        #: totals read these, so aggregates survive deque wraparound).
+        self._hists: Dict[str, LatencyHistogram] = {}
         self._lock = threading.Lock()
         self._t0 = time.perf_counter()
 
@@ -54,23 +168,37 @@ class Tracer:
                     (name, start - self._t0, dur, threading.get_ident(),
                      attrs or None)
                 )
-                self._totals[name] = self._totals.get(name, 0.0) + dur
+                h = self._hists.get(name)
+                if h is None:
+                    h = self._hists[name] = LatencyHistogram()
+                h.record(dur)
 
-    def record(self, name: str, duration_s: float, **attrs) -> None:
-        """Record an externally timed span (e.g. from a callback)."""
+    def record(self, name: str, duration_s: float,
+               start_s: Optional[float] = None, **attrs) -> None:
+        """Record an externally timed span (e.g. from a callback).
+
+        ``start_s``: the span's start as a ``time.perf_counter()`` value —
+        without it the span is placed ending "now", which misorders
+        retrospectively recorded phases on a timeline.
+        """
         if not self.enabled:
             return
+        if start_s is None:
+            start_s = time.perf_counter() - duration_s
         with self._lock:
             self._spans.append(
-                (name, time.perf_counter() - self._t0 - duration_s,
-                 duration_s, threading.get_ident(), attrs or None)
+                (name, start_s - self._t0, duration_s,
+                 threading.get_ident(), attrs or None)
             )
-            self._totals[name] = self._totals.get(name, 0.0) + duration_s
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = LatencyHistogram()
+            h.record(duration_s)
 
     def totals(self) -> Dict[str, float]:
         """Cumulative seconds per span name (O(names), never drops spans)."""
         with self._lock:
-            return dict(self._totals)
+            return {name: h.sum_s for name, h in self._hists.items()}
 
     def spans(self, name: Optional[str] = None) -> List[Span]:
         with self._lock:
@@ -80,38 +208,42 @@ class Tracer:
     def clear(self) -> None:
         with self._lock:
             self._spans.clear()
-            self._totals.clear()
+            self._hists.clear()
 
     # -- aggregation ---------------------------------------------------------
     def histogram(self, name: str) -> dict:
-        """Latency stats for one span name (the Push/Pull histogram)."""
-        durs = sorted(s[2] for s in self.spans(name))
-        if not durs:
-            return {"name": name, "count": 0}
-        n = len(durs)
+        """Latency stats for one span name (the Push/Pull histogram).
 
-        def pct(p: float) -> float:
-            return durs[min(n - 1, int(p * n))]
-
-        return {
-            "name": name,
-            "count": n,
-            "total_s": sum(durs),
-            "mean_us": 1e6 * sum(durs) / n,
-            "p50_us": 1e6 * pct(0.50),
-            "p90_us": 1e6 * pct(0.90),
-            "p99_us": 1e6 * pct(0.99),
-            "max_us": 1e6 * durs[-1],
-        }
+        Backed by the never-dropping :class:`LatencyHistogram`, so the
+        percentiles cover every span ever recorded under ``name`` — not
+        just the ones still in the bounded deque.
+        """
+        with self._lock:
+            h = self._hists.get(name)
+            stats = h.stats() if h is not None else {"count": 0}
+        return {"name": name, **stats}
 
     def summary(self) -> Dict[str, dict]:
         """Histogram per distinct span name."""
-        return {name: self.histogram(name) for name in
-                sorted({s[0] for s in self.spans()})}
+        with self._lock:
+            names = sorted(self._hists)
+        return {name: self.histogram(name) for name in names}
+
+    def digests(self) -> Dict[str, dict]:
+        """JSON-safe per-name histogram digests (heartbeat payload form)."""
+        with self._lock:
+            return {name: h.to_dict() for name, h in self._hists.items()}
 
     # -- export --------------------------------------------------------------
-    def dump_chrome_trace(self, path: str) -> None:
-        """Write the spans as a chrome://tracing / Perfetto JSON timeline."""
+    def dump_chrome_trace(self, path: str,
+                          process_name: Optional[str] = None) -> None:
+        """Write the spans as a chrome://tracing / Perfetto JSON timeline.
+
+        ``process_name`` (e.g. the node id): embeds a top-level
+        ``metadata`` block — the node name plus this tracer's perf_counter
+        epoch — that ``tools/merge_traces.py`` uses to label the process
+        and align per-node clocks on one merged timeline.
+        """
         events = [
             {
                 "name": name,
@@ -124,8 +256,11 @@ class Tracer:
             }
             for name, start, dur, tid, attrs in self.spans()
         ]
+        doc: dict = {"traceEvents": events}
+        if process_name is not None:
+            doc["metadata"] = {"node": process_name, "clock_t0_s": self._t0}
         with open(path, "w") as f:
-            json.dump({"traceEvents": events}, f)
+            json.dump(doc, f)
 
     def dump_jsonl(self, path: str) -> None:
         with open(path, "w") as f:

@@ -246,6 +246,93 @@ def test_latency_preserves_fifo_and_jitter_reorders():
         chaos.close()
 
 
+def test_slow_node_delays_inbound_only_and_heals():
+    """Gray failure: slow_node(B) adds a fixed delay to deliveries INTO B
+    (counted in chaos_slow), leaves other links untouched, and slow_ms=0
+    heals — all deterministic, no RNG draws."""
+    chaos = ChaosVan(LoopbackVan(), seed=0)
+    try:
+        got_b, got_c = [], []
+        chaos.bind("B", lambda m: got_b.append(time.perf_counter()))
+        chaos.bind("C", lambda m: got_c.append(time.perf_counter()))
+        chaos.slow_node("B", 80.0)
+
+        def send(recver):
+            t0 = time.perf_counter()
+            assert chaos.send(
+                Message(task=Task(TaskKind.CONTROL, "x"),
+                        sender="A", recver=recver)
+            )
+            return t0
+
+        t0 = send("B")
+        assert _settle(lambda: len(got_b) == 1)
+        assert got_b[0] - t0 >= 0.08  # the slow delay actually applied
+        t0 = send("C")
+        assert _settle(lambda: len(got_c) == 1)
+        assert got_c[0] - t0 < 0.08  # other links unaffected
+        assert chaos.counters()["chaos_slow"] == 1
+
+        chaos.slow_node("B", 0)  # heal
+        t0 = send("B")
+        assert _settle(lambda: len(got_b) == 2)
+        assert got_b[1] - t0 < 0.08
+        assert chaos.counters()["chaos_slow"] == 1  # no new injections
+    finally:
+        chaos.close()
+
+
+def test_slow_link_config_and_rng_isolation():
+    """Per-link ChaosConfig.slow_ms delays that link; a slow-only config
+    consumes NO RNG draws, so adding it to one link cannot shift the
+    seeded fault sequence of a randomized link (the four-draw contract)."""
+    def drops_on_ab(extra_slow_link):
+        chaos = ChaosVan(LoopbackVan(), seed=5)
+        try:
+            chaos.set_link("A", "B", ChaosConfig(drop=0.3))
+            if extra_slow_link:
+                chaos.set_link("A", "C", ChaosConfig(slow_ms=5.0))
+            chaos.bind("B", lambda m: None)
+            chaos.bind("C", lambda m: None)
+            for i in range(100):
+                chaos.send(Message(task=Task(TaskKind.CONTROL, "x", time=i),
+                                   sender="A", recver="B"))
+                if extra_slow_link:
+                    chaos.send(
+                        Message(task=Task(TaskKind.CONTROL, "x", time=i),
+                                sender="A", recver="C")
+                    )
+            drops = chaos.injected_drops
+            if extra_slow_link:
+                assert _settle(lambda: chaos.injected_slow == 100)
+            return drops
+        finally:
+            chaos.close()
+
+    assert drops_on_ab(False) == drops_on_ab(True) > 0
+
+
+def test_slow_node_composes_with_randomized_faults():
+    """slow + drop on the same link: delivered messages pay the slow delay,
+    drops still happen per the seeded schedule."""
+    chaos = ChaosVan(LoopbackVan(), seed=1, drop=0.2)
+    try:
+        got = []
+        chaos.bind("B", got.append)
+        chaos.slow_node("B", 30.0)
+        t0 = time.perf_counter()
+        for i in range(30):
+            chaos.send(Message(task=Task(TaskKind.CONTROL, "x", time=i),
+                               sender="A", recver="B"))
+        expect = 30 - chaos.injected_drops
+        assert chaos.injected_drops > 0
+        assert _settle(lambda: len(got) == expect)
+        assert time.perf_counter() - t0 >= 0.03  # slow applied to survivors
+        assert chaos.injected_slow == expect  # survivors only; drops exempt
+    finally:
+        chaos.close()
+
+
 def test_seed_determinism_across_runs():
     """The same seed yields the identical fault sequence: run a fixed
     single-threaded send script twice, compare injected counters AND the

@@ -1,0 +1,286 @@
+"""FleetMonitor: heartbeat-fed node series + straggler detection
+(core/fleet.py), and the Manager auto-stats heartbeat wiring.
+
+Acceptance anchor: with a seeded ChaosVan ``slow_node`` gray failure, the
+fleet monitor must flag the slowed node within 5 heartbeats and never flag
+the healthy ones in the same run.
+"""
+
+import io
+import json
+import time
+
+import numpy as np
+
+from parameter_server_tpu.config import OptimizerConfig, TableConfig
+from parameter_server_tpu.core.chaos import ChaosVan
+from parameter_server_tpu.core.fleet import FleetMonitor, StragglerPolicy
+from parameter_server_tpu.core.manager import SCHEDULER, launch_local_cluster
+from parameter_server_tpu.core.messages import server_id, worker_id
+from parameter_server_tpu.core.netmon import MeteredVan
+from parameter_server_tpu.core.resender import ReliableVan
+from parameter_server_tpu.core.van import LoopbackVan
+from parameter_server_tpu.kv.server import KVServer
+from parameter_server_tpu.kv.worker import KVWorker
+from parameter_server_tpu.utils.trace import LatencyHistogram
+
+
+def _digest(latencies_s, nbytes=1000, msgs=10):
+    h = LatencyHistogram()
+    for s in latencies_s:
+        h.record(s)
+    return {
+        "msgs": msgs, "bytes": nbytes,
+        "send": LatencyHistogram().to_dict(), "deliver": h.to_dict(),
+    }
+
+
+def _observe_round(fleet, now, slow_node=None, slow_s=0.2):
+    """One synthetic heartbeat round: 3 nodes, healthy links ~1ms, links
+    into ``slow_node`` at ``slow_s``."""
+    nodes = ["A", "B", "C"]
+    for n in nodes:
+        links = {}
+        for peer in nodes:
+            if peer == n:
+                continue
+            lat = slow_s if peer == slow_node else 0.001
+            links[f"{n}->{peer}"] = _digest([lat] * 4)
+        fleet.observe(n, {"links": links}, now=now)
+
+
+def test_straggler_flagged_within_five_beats_healthy_never():
+    """Acceptance (c), unit form: the slowed node is flagged by beat 5 (in
+    fact as soon as enough inbound samples exist) and healthy nodes are
+    never flagged at any point in the run."""
+    fleet = FleetMonitor(policy=StragglerPolicy(k=4.0, p99_floor_ms=40.0))
+    flagged_at = None
+    for beat in range(1, 6):
+        now = float(beat)
+        _observe_round(fleet, now, slow_node="C", slow_s=0.2)
+        flags = fleet.stragglers(now=now)
+        assert set(flags) <= {"C"}  # healthy nodes NEVER flagged
+        if "C" in flags and flagged_at is None:
+            flagged_at = beat
+    assert flagged_at is not None and flagged_at <= 5
+    reasons = fleet.stragglers(now=5.0)["C"]
+    assert any("p99" in r for r in reasons)
+
+
+def test_healthy_fleet_has_no_stragglers():
+    fleet = FleetMonitor()
+    for beat in range(1, 6):
+        _observe_round(fleet, float(beat))
+        assert fleet.stragglers(now=float(beat)) == {}
+
+
+def test_absolute_floor_suppresses_microsecond_jitter():
+    """One node 10x slower than the fleet but at microsecond scale: the
+    relative detector would fire, the absolute floor must not."""
+    fleet = FleetMonitor(policy=StragglerPolicy(k=4.0, p99_floor_ms=10.0))
+    for beat in range(1, 6):
+        _observe_round(fleet, float(beat), slow_node="C", slow_s=50e-6)
+        assert fleet.stragglers(now=float(beat)) == {}
+
+
+def test_heartbeat_gap_straggler():
+    """A node that stops beating (but never died) is flagged on gap vs the
+    fleet's median beat interval."""
+    fleet = FleetMonitor(policy=StragglerPolicy(k=4.0, gap_floor_s=1.0))
+    for beat in range(10):
+        now = 0.5 * beat
+        for n in ("A", "B"):
+            fleet.observe(n, {}, now=now)
+        if beat < 3:  # C beats 3 times, then goes silent
+            fleet.observe("C", {}, now=now)
+    # at now=5.0 A/B last beat 0.5s ago (healthy); C has been silent 4s —
+    # past k x the 0.5s fleet median AND the absolute floor
+    flags = fleet.stragglers(now=5.0)
+    assert set(flags) == {"C"}
+    assert any("silent" in r for r in flags["C"])
+    snap = fleet.snapshot(now=5.0)
+    assert snap["A"]["heartbeats"] == 10
+    assert snap["C"]["heartbeats"] == 3
+
+
+def test_snapshot_derives_rates_and_inbound_latency():
+    fleet = FleetMonitor()
+    for beat in range(1, 4):
+        now = float(beat)
+        fleet.observe(
+            "A",
+            {
+                "resource": {
+                    "time": 100.0 + beat, "rss_mb": 50.0,
+                    "cpu_user_s": 0.5 * beat, "cpu_sys_s": 0.0,
+                },
+                "net": {"wire_bytes": 1000 * beat},
+                "links": {"A->B": _digest([0.002] * 5)},
+            },
+            now=now,
+        )
+        fleet.observe("B", {}, now=now)
+    snap = fleet.snapshot(now=3.0)
+    a = snap["A"]
+    assert a["heartbeats"] == 3
+    assert a["beat_interval_s"] == 1.0
+    assert a["rss_mb"] == 50.0
+    assert abs(a["cpu_pct"] - 50.0) < 1e-6  # 0.5 cpu-s per 1s wall
+    assert a["wire_bytes_per_s"] == 1000.0
+    # the A->B link is inbound to B, not A
+    assert "push_p99_ms" not in a
+    assert snap["B"]["inbound_count"] == 5
+    assert snap["B"]["push_p99_ms"] >= snap["B"]["push_p50_ms"]
+
+
+def test_write_jsonl_rows():
+    sink = io.StringIO()
+    fleet = FleetMonitor(jsonl=sink)
+    for beat in range(1, 4):
+        _observe_round(fleet, float(beat), slow_node="C", slow_s=0.2)
+        fleet.write_jsonl(now=float(beat))
+    rows = [json.loads(line) for line in sink.getvalue().splitlines()]
+    assert len(rows) == 3
+    for row in rows:
+        assert set(row) == {"t", "nodes", "stragglers"}
+        assert set(row["nodes"]) == {"A", "B", "C"}
+    assert "C" in rows[-1]["stragglers"]
+
+
+def test_cumulative_digests_replace_not_double_count():
+    """Heartbeats carry CUMULATIVE link digests; re-observing a grown
+    snapshot of the same link must not double-count earlier samples."""
+    fleet = FleetMonitor()
+    h = LatencyHistogram()
+    for i in range(1, 6):
+        h.record(0.001)
+        d = {"msgs": i, "bytes": 100 * i,
+             "send": LatencyHistogram().to_dict(), "deliver": h.to_dict()}
+        fleet.observe("A", {"links": {"A->B": d}}, now=float(i))
+        fleet.observe("B", {}, now=float(i))
+    assert fleet.snapshot(now=5.0)["B"]["inbound_count"] == 5  # not 1+2+..+5
+
+
+def test_manager_heartbeat_autostats_feed_fleet():
+    """End-to-end wiring: Manager.send_heartbeat(auto=True) over a metered
+    van attaches resource/net/links, and the scheduler's _on_heartbeat
+    feeds them into the attached FleetMonitor."""
+    van = MeteredVan(LoopbackVan())
+    try:
+        sched, managers, posts = launch_local_cluster(
+            van, num_workers=1, num_servers=1
+        )
+        fleet = FleetMonitor()
+        sched.fleet = fleet
+        cfgs = {
+            "w": TableConfig(
+                name="w", rows=256, dim=1,
+                optimizer=OptimizerConfig(kind="sgd", learning_rate=0.1),
+            )
+        }
+        KVServer(posts[server_id(0)], cfgs, 0, 1)
+        worker = KVWorker(posts[worker_id(0)], cfgs, 1, min_bucket=16)
+        keys = np.arange(30, dtype=np.uint64)
+        assert worker.wait(
+            worker.push("w", keys, np.ones(30, np.float32)), timeout=30
+        )
+        for nid, mgr in managers.items():
+            if nid != SCHEDULER:
+                assert mgr.wait(mgr.send_heartbeat(), timeout=30)
+        assert set(fleet.nodes()) == {server_id(0), worker_id(0)}
+        snap = fleet.snapshot()
+        w = snap[worker_id(0)]
+        assert w["heartbeats"] == 1
+        # the push traffic W0->S0 lands as S0 inbound latency
+        assert snap[server_id(0)].get("inbound_count", 0) > 0
+        assert w["last_seen_s"] is not None
+    finally:
+        van.close()
+
+
+def test_e2e_slow_node_flagged_within_five_heartbeats():
+    """Acceptance (c), full stack: Metered(Reliable(Chaos(Loopback))) with a
+    seeded ``slow_node`` gray failure on one server — traffic + heartbeats
+    => the slowed server is flagged within 5 beats; healthy nodes never."""
+    chaos = ChaosVan(LoopbackVan(), seed=0)
+    reliable = ReliableVan(
+        chaos, timeout=5.0, backoff=1.0, max_retries=3, seed=0
+    )
+    van = MeteredVan(reliable)
+    try:
+        sched, managers, posts = launch_local_cluster(
+            van, num_workers=2, num_servers=2
+        )
+        fleet = FleetMonitor(
+            policy=StragglerPolicy(k=4.0, p99_floor_ms=40.0)
+        )
+        sched.fleet = fleet
+        cfgs = {
+            "w": TableConfig(
+                name="w", rows=1 << 10, dim=2,
+                optimizer=OptimizerConfig(kind="sgd", learning_rate=0.1),
+            )
+        }
+        servers = [
+            KVServer(posts[server_id(s)], cfgs, s, 2) for s in range(2)
+        ]
+        workers = [
+            KVWorker(posts[worker_id(w)], cfgs, 2, min_bucket=16)
+            for w in range(2)
+        ]
+        chaos.slow_node(server_id(1), 120.0)  # the gray failure
+        rng = np.random.default_rng(1)
+        flagged_at = None
+        for beat in range(1, 6):
+            for w in workers:
+                keys = rng.integers(0, 1 << 10, size=48).astype(np.uint64)
+                grads = rng.standard_normal((48, 2)).astype(np.float32)
+                assert w.wait(w.push("w", keys, grads), timeout=60)
+            for nid, mgr in managers.items():
+                if nid != SCHEDULER:
+                    assert mgr.wait(mgr.send_heartbeat(), timeout=60)
+            flags = fleet.stragglers()
+            assert set(flags) <= {server_id(1)}  # healthy: never flagged
+            if server_id(1) in flags and flagged_at is None:
+                flagged_at = beat
+        assert flagged_at is not None and flagged_at <= 5, (
+            f"gray server not flagged in 5 beats; "
+            f"snapshot={fleet.snapshot()}"
+        )
+        assert chaos.injected_slow > 0
+        del servers
+    finally:
+        van.close()
+
+
+def test_slow_node_heals_and_flags_clear_on_fresh_monitor():
+    """slow_node(nid, 0) heals the link; a fresh monitor over post-heal
+    traffic sees a healthy fleet (histograms are cumulative, so clearing
+    needs a new monitor — same as restarting the scheduler sweep)."""
+    chaos = ChaosVan(LoopbackVan(), seed=0)
+    van = MeteredVan(chaos)
+    try:
+        got = []
+        van.bind("B", got.append)
+        van.bind("A", got.append)
+        chaos.slow_node("B", 50.0)
+        from parameter_server_tpu.core.messages import Message, Task, TaskKind
+
+        t0 = time.perf_counter()
+        van.send(Message(task=Task(TaskKind.CONTROL, "x"),
+                         sender="A", recver="B"))
+        deadline = time.time() + 5
+        while len(got) < 1 and time.time() < deadline:
+            time.sleep(0.005)
+        assert time.perf_counter() - t0 >= 0.05
+        chaos.slow_node("B", 0)  # heal
+        t1 = time.perf_counter()
+        van.send(Message(task=Task(TaskKind.CONTROL, "x"),
+                         sender="A", recver="B"))
+        deadline = time.time() + 5
+        while len(got) < 2 and time.time() < deadline:
+            time.sleep(0.005)
+        assert len(got) == 2
+        assert time.perf_counter() - t1 < 0.05
+    finally:
+        van.close()

@@ -1,0 +1,250 @@
+"""MeteredVan wire accounting (core/netmon.py).
+
+Acceptance anchor: over a 2-worker/2-server cluster on the full
+``MeteredVan(ReliableVan(ChaosVan(LoopbackVan())))`` stack, the meter's
+per-link byte counters must EXACTLY equal the sum of each message's
+keys/values nbytes — ground-truthed by an independent probe wrapper ABOVE
+the meter, so retransmits/ACKs/dups in the layers below cannot contaminate
+the logical counts.
+"""
+
+import time
+
+import numpy as np
+
+from parameter_server_tpu.config import OptimizerConfig, TableConfig
+from parameter_server_tpu.core.chaos import ChaosVan
+from parameter_server_tpu.core.messages import Message, Task, TaskKind
+from parameter_server_tpu.core.netmon import (
+    STAMP_KEY,
+    MeteredVan,
+    find_metered,
+    payload_nbytes,
+)
+from parameter_server_tpu.core.postoffice import Postoffice
+from parameter_server_tpu.core.resender import ReliableVan
+from parameter_server_tpu.core.van import LoopbackVan, VanWrapper
+from parameter_server_tpu.kv.server import KVServer
+from parameter_server_tpu.kv.worker import KVWorker
+from parameter_server_tpu.utils.metrics import transport_counters
+
+NUM_SERVERS = 2
+ROWS = 1 << 10
+
+
+def _settle(predicate, deadline_s=5.0):
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.01)
+    return predicate()
+
+
+class ProbeVan(VanWrapper):
+    """Independent byte ground truth, stacked ABOVE the meter: counts each
+    logical message's keys+values nbytes per directed link."""
+
+    def __init__(self, inner):
+        super().__init__(inner)
+        self.bytes = {}
+        self.msgs = {}
+
+    def send(self, msg):
+        link = f"{msg.sender}->{msg.recver}"
+        nb = 0
+        if msg.keys is not None:
+            nb += int(np.asarray(msg.keys).nbytes)
+        for v in msg.values:
+            nb += int(np.asarray(v).nbytes)
+        self.bytes[link] = self.bytes.get(link, 0) + nb
+        self.msgs[link] = self.msgs.get(link, 0) + 1
+        return self.inner.send(msg)
+
+
+def _table_cfgs():
+    return {
+        "w": TableConfig(
+            name="w", rows=ROWS, dim=2,
+            optimizer=OptimizerConfig(kind="sgd", learning_rate=0.1),
+        )
+    }
+
+
+def test_single_message_bytes_exact():
+    van = MeteredVan(LoopbackVan())
+    try:
+        got = []
+        van.bind("B", got.append)
+        keys = np.arange(10, dtype=np.int64)
+        vals = np.ones((10, 4), np.float32)
+        msg = Message(
+            task=Task(TaskKind.PUSH, "kv", payload={"table": "w"}),
+            sender="A", recver="B", keys=keys, values=[vals],
+        )
+        assert payload_nbytes(msg) == keys.nbytes + vals.nbytes
+        assert van.send(msg)
+        assert _settle(lambda: len(got) == 1)
+        links = van.links()
+        assert links["A->B"]["msgs"] == 1
+        assert links["A->B"]["bytes"] == keys.nbytes + vals.nbytes
+        c = van.counters()
+        assert c["wire_msgs"] == 1
+        assert c["wire_bytes"] == keys.nbytes + vals.nbytes
+        assert c["wire_links"] == 1
+        assert c["wire_undeliverable"] == 0
+        # the monotonic stamp is stripped before the handler sees the message
+        assert STAMP_KEY not in got[0].task.payload
+        assert got[0].task.payload["table"] == "w"
+    finally:
+        van.close()
+
+
+def test_deliver_latency_recorded_and_nonnegative():
+    van = MeteredVan(LoopbackVan())
+    try:
+        van.bind("B", lambda m: None)
+        for _ in range(5):
+            van.send(
+                Message(task=Task(TaskKind.CONTROL, "x"),
+                        sender="A", recver="B")
+            )
+        assert _settle(
+            lambda: van.links()["A->B"]["deliver"]["count"] == 5
+        )
+        d = van.links()["A->B"]
+        assert d["send"]["count"] == 5
+        assert d["deliver"]["max_s"] >= 0.0
+    finally:
+        van.close()
+
+
+def test_stamp_false_disables_deliver_histogram():
+    van = MeteredVan(LoopbackVan(), stamp=False)
+    try:
+        got = []
+        van.bind("B", got.append)
+        van.send(
+            Message(task=Task(TaskKind.CONTROL, "x"), sender="A", recver="B")
+        )
+        assert _settle(lambda: len(got) == 1)
+        d = van.links()["A->B"]
+        assert d["msgs"] == 1
+        assert d["deliver"]["count"] == 0  # no stamp, no latency
+    finally:
+        van.close()
+
+
+def test_undeliverable_counted():
+    van = MeteredVan(LoopbackVan())
+    try:
+        msg = Message(
+            task=Task(TaskKind.CONTROL, "x"), sender="A", recver="NOWHERE"
+        )
+        assert not van.send(msg)  # inner send fails: no such endpoint
+        assert van.counters()["wire_undeliverable"] == 1
+        assert van.counters()["wire_msgs"] == 1  # still counted as traffic
+    finally:
+        van.close()
+
+
+def test_find_metered_walks_wrapper_stack():
+    metered = MeteredVan(ChaosVan(LoopbackVan()))
+    stack = ProbeVan(metered)
+    try:
+        assert find_metered(stack) is metered
+        assert find_metered(LoopbackVan()) is None
+    finally:
+        stack.close()
+
+
+def test_node_digests_report_only_originated_links():
+    van = MeteredVan(LoopbackVan())
+    try:
+        van.bind("A", lambda m: None)
+        van.bind("B", lambda m: None)
+        van.send(Message(task=Task(TaskKind.CONTROL, "x"),
+                         sender="A", recver="B"))
+        van.send(Message(task=Task(TaskKind.CONTROL, "x"),
+                         sender="B", recver="A"))
+        assert _settle(lambda: van.counters()["wire_links"] == 2)
+        assert set(van.node_digests("A")) == {"A->B"}
+        assert set(van.node_digests("B")) == {"B->A"}
+    finally:
+        van.close()
+
+
+def test_cluster_bytes_exact_over_metered_reliable_chaos_stack():
+    """Acceptance (a): 2 workers x 2 servers over the full observability
+    stack — per-link byte counters exactly equal the sum of message
+    keys/values nbytes.  Chaos runs drop+dup BELOW the meter (latency 0,
+    per the chaos determinism ground rules), so the wire repairs itself
+    while the logical per-link accounting stays byte-exact."""
+    chaos = ChaosVan(LoopbackVan(), seed=2, drop=0.1, duplicate=0.1)
+    reliable = ReliableVan(
+        chaos, timeout=0.05, backoff=1.0, max_retries=60, seed=2
+    )
+    metered = MeteredVan(reliable)
+    van = ProbeVan(metered)  # ground truth ABOVE the meter
+    try:
+        cfgs = _table_cfgs()
+        servers = [
+            KVServer(Postoffice(f"S{s}", van), cfgs, s, NUM_SERVERS)
+            for s in range(NUM_SERVERS)
+        ]
+        workers = [
+            KVWorker(Postoffice(f"W{w}", van), cfgs, NUM_SERVERS,
+                     min_bucket=16)
+            for w in range(2)
+        ]
+        rng = np.random.default_rng(0)
+        for _ in range(4):
+            for w in workers:
+                keys = rng.integers(0, ROWS, size=48).astype(np.uint64)
+                grads = rng.standard_normal((48, 2)).astype(np.float32)
+                assert w.wait(w.push("w", keys, grads), timeout=30)
+                w.pull_sync("w", keys, timeout=30)
+        assert van.flush(10)  # every send acked; wire quiescent
+        links = metered.links()
+        assert set(links) == set(van.bytes)
+        for link, truth in van.bytes.items():
+            assert links[link]["bytes"] == truth, link
+            assert links[link]["msgs"] == van.msgs[link], link
+        assert chaos.injected_drops + chaos.injected_dups > 0
+        # worker->server links carry the key+grad tensors; byte-positive
+        assert links["W0->S0"]["bytes"] > 0
+        merged = transport_counters(van)
+        assert merged["wire_bytes"] == sum(van.bytes.values())
+        assert merged["wire_msgs"] == sum(van.msgs.values())
+        assert merged["retransmits"] >= 0  # resender layer merged in
+        assert merged["chaos_drops"] == chaos.injected_drops
+        del servers
+    finally:
+        van.close()
+
+
+def test_reply_leg_has_no_stale_stamp_latency():
+    """msg.reply() shares the Task: the meter must strip its stamp on
+    receive, or the response leg would record send->reply time-travel.
+    Deliver latencies on the reply link must therefore be small and
+    non-negative (not the full request round trip)."""
+    van = MeteredVan(LoopbackVan())
+    try:
+        cfgs = _table_cfgs()
+        KVServer(Postoffice("S0", van), cfgs, 0, 1)
+        worker = KVWorker(Postoffice("W0", van), cfgs, 1, min_bucket=16)
+        keys = np.arange(20, dtype=np.uint64)
+        for _ in range(3):
+            assert worker.wait(
+                worker.push("w", keys, np.ones((20, 2), np.float32)),
+                timeout=30,
+            )
+        assert _settle(
+            lambda: van.links().get("S0->W0", {"deliver": {"count": 0}})[
+                "deliver"]["count"] >= 3
+        )
+        reply = van.links()["S0->W0"]["deliver"]
+        assert reply["count"] >= 3
+        assert reply["max_s"] >= 0.0
+    finally:
+        van.close()

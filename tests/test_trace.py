@@ -1,5 +1,6 @@
 """Tracing subsystem: spans, histograms, exports, KV-layer wiring."""
 
+import builtins
 import json
 import threading
 import time
@@ -11,7 +12,12 @@ from parameter_server_tpu.core.postoffice import Postoffice
 from parameter_server_tpu.core.van import LoopbackVan
 from parameter_server_tpu.kv.server import KVServer
 from parameter_server_tpu.kv.worker import KVWorker
-from parameter_server_tpu.utils.trace import NULL_TRACER, Tracer, resource_usage
+from parameter_server_tpu.utils.trace import (
+    NULL_TRACER,
+    LatencyHistogram,
+    Tracer,
+    resource_usage,
+)
 
 
 def test_span_recording_and_histogram():
@@ -74,6 +80,96 @@ def test_resource_usage_fields():
     assert ru["rss_mb"] > 1.0
     assert ru["cpu_user_s"] >= 0.0
     assert ru["threads"] >= 1
+
+
+def test_resource_usage_non_linux_fallback(monkeypatch):
+    """No /proc (macOS/Windows): a time-only dict, never an exception."""
+    real_open = builtins.open
+
+    def fake_open(path, *args, **kwargs):
+        if str(path).startswith("/proc/"):
+            raise OSError("no /proc on this platform")
+        return real_open(path, *args, **kwargs)
+
+    monkeypatch.setattr(builtins, "open", fake_open)
+    ru = resource_usage()
+    assert set(ru) == {"time"}
+    assert ru["time"] > 0
+
+
+# ------------------------------------------------------- LatencyHistogram
+
+
+def test_latency_histogram_exact_moments_and_bounded_percentiles():
+    h = LatencyHistogram()
+    values = [0.0005, 0.001, 0.002, 0.004, 0.008, 0.5]
+    for v in values:
+        h.record(v)
+    assert h.count == len(values)
+    assert abs(h.sum_s - sum(values)) < 1e-12  # count/sum/max are EXACT
+    assert h.max_s == 0.5
+    # percentiles are bucket upper bounds: >= the true quantile, <= max,
+    # within the 25% bucket growth factor
+    p50 = h.percentile(0.50)
+    assert 0.002 <= p50 <= 0.002 * LatencyHistogram.GROWTH
+    assert h.percentile(0.99) <= h.max_s
+    assert h.percentile(1.0) == h.max_s
+    # negative durations clamp to bucket 0, never throw
+    h.record(-1.0)
+    assert h.count == len(values) + 1
+
+
+def test_latency_histogram_empty_and_extremes():
+    h = LatencyHistogram()
+    assert h.percentile(0.99) == 0.0
+    assert h.stats() == {"count": 0}
+    h.record(1e-9)  # below BASE -> bucket 0
+    h.record(1e9)  # beyond the last bucket -> max stays exact, but
+    # percentiles saturate at the last bucket's upper edge (<= max)
+    assert h.max_s == 1e9
+    assert h.percentile(1.0) <= h.max_s
+    last_edge = LatencyHistogram.BASE * (
+        LatencyHistogram.GROWTH ** (LatencyHistogram.NBUCKETS - 1)
+    )
+    assert h.percentile(1.0) == last_edge  # ~27 min: the range ceiling
+
+
+def test_latency_histogram_merge_equals_union():
+    a, b, u = LatencyHistogram(), LatencyHistogram(), LatencyHistogram()
+    for i in range(50):
+        v = 1e-5 * (i + 1)
+        (a if i % 2 else b).record(v)
+        u.record(v)
+    a.merge(b)
+    assert a.counts == u.counts
+    assert a.count == u.count
+    assert abs(a.sum_s - u.sum_s) < 1e-12
+    assert a.percentile(0.9) == u.percentile(0.9)
+
+
+def test_latency_histogram_dict_round_trip():
+    h = LatencyHistogram()
+    for v in (1e-5, 3e-4, 0.02, 1.5):
+        h.record(v)
+    d = h.to_dict()
+    json.dumps(d)  # heartbeat-safe
+    back = LatencyHistogram.from_dict(d)
+    assert back.counts == h.counts
+    assert back.count == h.count
+    assert back.max_s == h.max_s
+
+
+def test_tracer_histogram_survives_deque_wraparound():
+    """The old bounded-deque histogram silently became 'stats of the last
+    capacity spans'; the LatencyHistogram backing must count everything."""
+    tr = Tracer(capacity=10)
+    for _ in range(100):
+        tr.record("op", 0.001)
+    assert len(tr.spans("op")) == 10  # timeline stays bounded...
+    assert tr.histogram("op")["count"] == 100  # ...aggregates do not
+    assert tr.totals()["op"] >= 0.1 - 1e-9
+    digests = tr.digests()
+    assert digests["op"]["count"] == 100
 
 
 def test_kv_layer_traced_push_pull():

@@ -1,0 +1,164 @@
+#!/usr/bin/env python
+"""Merge per-node chrome-trace dumps into one Perfetto timeline.
+
+Each node of a cluster run dumps its own timeline
+(``Tracer.dump_chrome_trace(path, process_name=node_id)``).  Loaded alone,
+those files are N disconnected views of one distributed request; merged,
+each node becomes a Perfetto *process* (pid = node index, named via
+``process_name`` metadata events), and the worker-side ``kv.push`` span
+lines up with the serving nodes' ``kv.server.push`` spans — both carry the
+same stitched trace id in ``args.trace`` (stamped into
+``Task.payload["__trace__"]`` by ``KVWorker._trace_ctx`` and echoed by
+``KVServer.handle_request``), so clicking one end finds the other.
+
+Clock alignment: every Tracer records span starts relative to its own
+construction time.  ``dump_chrome_trace(..., process_name=...)`` embeds
+that epoch (``metadata.clock_t0_s``, a ``perf_counter`` value), and the
+merge rebases each file's events onto the shared clock — exact for
+in-process clusters (one perf_counter domain), best-effort across OS
+processes (as with any unsynchronized one-way timestamps).
+
+Usage::
+
+    python tools/merge_traces.py -o merged.json trace_W0.json trace_S0.json ...
+
+Node names come from each file's ``metadata.node``, else the file stem.
+The output is plain chrome-trace JSON ("traceEvents" array) — open with
+https://ui.perfetto.dev or chrome://tracing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+#: ph values this tool understands (complete spans + metadata).
+_KNOWN_PHASES = {"X", "M"}
+
+
+def load_trace(path: str) -> Tuple[str, dict]:
+    """Read one per-node dump; returns (node_name, document)."""
+    with open(path) as f:
+        doc = json.load(f)
+    meta = doc.get("metadata") or {}
+    node = meta.get("node") or os.path.splitext(os.path.basename(path))[0]
+    return str(node), doc
+
+
+def merge_traces(
+    paths: List[str], nodes: Optional[List[str]] = None
+) -> dict:
+    """Merge per-node chrome traces into one multi-process document.
+
+    ``nodes``: optional explicit node names (parallel to ``paths``),
+    overriding embedded/filename-derived names.  Input order fixes pid
+    assignment (pid = 1 + index), so merges are deterministic.
+    """
+    events: List[dict] = []
+    # rebase every file to the EARLIEST embedded clock epoch so merged ts
+    # stay positive and relative offsets between nodes are preserved
+    loaded = []
+    t0s = []
+    for i, path in enumerate(paths):
+        node, doc = load_trace(path)
+        if nodes is not None:
+            node = nodes[i]
+        t0 = (doc.get("metadata") or {}).get("clock_t0_s")
+        loaded.append((node, doc, t0))
+        if t0 is not None:
+            t0s.append(t0)
+    base_t0 = min(t0s) if t0s else None
+    for pid, (node, doc, t0) in enumerate(loaded, start=1):
+        shift_us = (
+            (t0 - base_t0) * 1e6 if (t0 is not None and base_t0 is not None)
+            else 0.0
+        )
+        events.append(
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": pid,
+                "tid": 0,
+                "args": {"name": node},
+            }
+        )
+        for ev in doc.get("traceEvents", []):
+            ev = dict(ev)
+            if ev.get("ph") == "M":
+                continue  # per-file metadata is superseded by ours
+            ev["pid"] = pid
+            if "ts" in ev:
+                ev["ts"] = ev["ts"] + shift_us
+            events.append(ev)
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def validate_chrome_trace(doc: dict) -> List[str]:
+    """Schema check: the invariants Perfetto's importer relies on.
+
+    Returns a list of problems (empty = valid): a ``traceEvents`` array
+    where every event has a string ``name`` and known ``ph``; complete
+    ("X") events also need numeric ``ts`` + non-negative ``dur`` and
+    integer ``pid``/``tid``.
+    """
+    problems: List[str] = []
+    events = doc.get("traceEvents")
+    if not isinstance(events, list):
+        return ["traceEvents missing or not a list"]
+    for i, ev in enumerate(events):
+        where = f"event[{i}]"
+        if not isinstance(ev, dict):
+            problems.append(f"{where}: not an object")
+            continue
+        if not isinstance(ev.get("name"), str):
+            problems.append(f"{where}: name missing or not a string")
+        ph = ev.get("ph")
+        if ph not in _KNOWN_PHASES:
+            problems.append(f"{where}: unknown ph {ph!r}")
+            continue
+        if not isinstance(ev.get("pid"), int):
+            problems.append(f"{where}: pid missing or not an int")
+        if ph == "X":
+            if not isinstance(ev.get("ts"), (int, float)):
+                problems.append(f"{where}: ts missing or not numeric")
+            dur = ev.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                problems.append(f"{where}: dur missing/negative")
+            if not isinstance(ev.get("tid"), int):
+                problems.append(f"{where}: tid missing or not an int")
+        if "args" in ev and not isinstance(ev["args"], dict):
+            problems.append(f"{where}: args not an object")
+    return problems
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="merge per-node chrome traces into one Perfetto timeline"
+    )
+    ap.add_argument("traces", nargs="+", help="per-node trace JSON files")
+    ap.add_argument(
+        "-o", "--output", default="merged_trace.json",
+        help="merged output path (default: %(default)s)",
+    )
+    args = ap.parse_args(argv)
+    merged = merge_traces(args.traces)
+    problems = validate_chrome_trace(merged)
+    if problems:
+        for p in problems:
+            print(f"merge_traces: {p}", file=sys.stderr)
+        return 1
+    with open(args.output, "w") as f:
+        json.dump(merged, f)
+    n_spans = sum(1 for e in merged["traceEvents"] if e.get("ph") == "X")
+    print(
+        f"merged {len(args.traces)} node traces ({n_spans} spans) "
+        f"-> {args.output}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
