@@ -27,6 +27,14 @@ Van decorator that injects in-flight faults between ``send`` and delivery:
   design: a gray node's observable symptom is work queueing at ITS door,
   and metering attributes deliver latency to the destination, so the
   detector's signal lands on the right node.
+- **corrupt**: one payload bit flipped in flight, in a COPY of one
+  keys/values array (the sender's buffer is a retransmit source and is
+  never mutated).  Caught end-to-end by the CRC32 integrity stamp in
+  ``core/resender.py`` (``rejected_corrupt``); the dropped ACK makes the
+  sender retransmit the pristine original, so recovery is automatic;
+- **bandwidth** (``ChaosConfig.bandwidth_bps``): a per-link deterministic
+  token bucket over payload bytes — each delivery waits for the link's
+  virtual transmit clock, modeling a capped pipe without any RNG draws.
 
 Determinism: every decision comes from a per-link ``random.Random`` keyed
 by ``(seed, sender, recver)`` via crc32, and exactly four uniforms are
@@ -54,8 +62,23 @@ import time
 import zlib
 from typing import Callable, Dict, Optional, Tuple
 
+import numpy as np
+
 from parameter_server_tpu.core.messages import Message
 from parameter_server_tpu.core.van import Van, VanWrapper
+
+
+def payload_nbytes(msg: Message) -> int:
+    """Wire size of a message's bulk payload (keys + values), in bytes.
+
+    Only objects exposing ``nbytes`` count (numpy / device arrays); the
+    small dict payload is control-plane noise next to them and is ignored,
+    which keeps the bandwidth model focused on the data plane.
+    """
+    size = int(getattr(msg.keys, "nbytes", 0) or 0)
+    for v in msg.values:
+        size += int(getattr(v, "nbytes", 0) or 0)
+    return size
 
 
 @dataclasses.dataclass(frozen=True)
@@ -79,6 +102,18 @@ class ChaosConfig:
     #: link.  Deterministic — no RNG draw — so a slowed link never shifts
     #: the fault sequence of drop/dup/reorder decisions.
     slow_ms: float = 0.0
+    #: P(one payload bit flipped in flight).  Draws come from a SEPARATE
+    #: per-link RNG stream (keyed ``corrupt:``), so enabling corruption
+    #: never shifts the seeded drop/dup/reorder schedule of this or any
+    #: other link.  The flip lands in a COPY of one key/value array — the
+    #: sender's buffer (a retransmit source) is never touched.
+    corrupt: float = 0.0
+    #: per-link bandwidth cap in bytes/sec (0 = uncapped): a deterministic
+    #: token bucket over payload bytes delays each delivery until the
+    #: link's virtual transmit clock frees up.  Zero RNG draws, so seeded
+    #: fault schedules are unperturbed; FIFO is preserved (delays are
+    #: monotone along a link).
+    bandwidth_bps: float = 0.0
 
     @property
     def randomized(self) -> bool:
@@ -95,7 +130,12 @@ class ChaosConfig:
 
     @property
     def inert(self) -> bool:
-        return not self.randomized and self.slow_ms == 0.0
+        return (
+            not self.randomized
+            and self.slow_ms == 0.0
+            and self.corrupt == 0.0
+            and self.bandwidth_bps == 0.0
+        )
 
 
 class TimerWheel:
@@ -174,12 +214,15 @@ class ChaosVan(VanWrapper):
         reorder: float = 0.0,
         delay: float = 0.0,
         jitter: float = 0.0,
+        corrupt: float = 0.0,
+        bandwidth_bps: float = 0.0,
     ) -> None:
         super().__init__(inner)
         if default is None:
             default = ChaosConfig(
                 drop=drop, duplicate=duplicate, reorder=reorder,
-                delay=delay, jitter=jitter,
+                delay=delay, jitter=jitter, corrupt=corrupt,
+                bandwidth_bps=bandwidth_bps,
             )
         self.seed = seed
         self.default = default
@@ -194,11 +237,20 @@ class ChaosVan(VanWrapper):
         self.injected_dups = 0
         self.injected_reorders = 0
         self.injected_slow = 0
+        self.injected_corrupt = 0
+        self.bandwidth_delays = 0
         self.partition_drops = 0
         self.unreachable_drops = 0
         self.forwarded = 0
         #: gray failures: node id -> extra inbound delivery delay (seconds).
         self._slow: Dict[str, float] = {}
+        #: corruption RNGs live in a SEPARATE per-link stream (keyed
+        #: ``corrupt:``) so enabling bit-flips never shifts the seeded
+        #: drop/dup/reorder schedule drawn from ``_rng``.
+        self._corrupt_rngs: Dict[Tuple[str, str], random.Random] = {}
+        #: token bucket: link -> monotonic time its virtual transmit clock
+        #: frees up (bandwidth_bps caps).  Deterministic, draw-free.
+        self._bw_free: Dict[Tuple[str, str], float] = {}
 
     # -- configuration -------------------------------------------------------
     def set_link(self, sender: str, recver: str, cfg: ChaosConfig) -> None:
@@ -248,6 +300,45 @@ class ChaosVan(VanWrapper):
             r = self._rngs[link] = random.Random(key)
         return r
 
+    def _corrupt_rng(self, link: Tuple[str, str]) -> random.Random:
+        r = self._corrupt_rngs.get(link)
+        if r is None:
+            key = zlib.crc32(
+                f"{self.seed}:corrupt:{link[0]}->{link[1]}".encode()
+            )
+            r = self._corrupt_rngs[link] = random.Random(key)
+        return r
+
+    @staticmethod
+    def _flip_bit(msg: Message, rng: random.Random) -> Optional[Message]:
+        """Return a shallow copy of ``msg`` with one payload bit flipped.
+
+        The flip lands in a COPY of one numpy array: the original message
+        object is a retransmit source held by the sender's ReliableVan, so
+        in-place mutation would poison every future retransmit and make
+        recovery impossible.  Device-resident (non-numpy) values are not
+        candidates — matching the CRC stamp's coverage in
+        ``core/resender.py``.  Returns None when nothing is corruptible.
+        """
+        candidates = []
+        if isinstance(msg.keys, np.ndarray) and msg.keys.nbytes > 0:
+            candidates.append(("keys", None))
+        for i, v in enumerate(msg.values):
+            if isinstance(v, np.ndarray) and v.nbytes > 0:
+                candidates.append(("values", i))
+        if not candidates:
+            return None
+        where, idx = candidates[rng.randrange(len(candidates))]
+        target = msg.keys if where == "keys" else msg.values[idx]
+        corrupted = target.copy()
+        flat = corrupted.view(np.uint8).reshape(-1)
+        flat[rng.randrange(flat.size)] ^= np.uint8(1 << rng.randrange(8))
+        if where == "keys":
+            return dataclasses.replace(msg, keys=corrupted)
+        values = list(msg.values)
+        values[idx] = corrupted
+        return dataclasses.replace(msg, values=values)
+
     def send(self, msg: Message) -> bool:
         if self._closed:
             return False
@@ -269,7 +360,31 @@ class ChaosVan(VanWrapper):
                 u_dup = rng.random()
                 u_jit = rng.random()
                 u_reord = rng.random()
-        if not randomized and slow == 0.0:
+            # corruption draws from its own stream — isolated from the four
+            # draws above, so flipping cfg.corrupt on cannot shift the
+            # seeded drop/dup/reorder schedule of this or any other link
+            corrupt_hit = False
+            if cfg.corrupt > 0.0:
+                crng = self._corrupt_rng(link)
+                corrupt_hit = crng.random() < cfg.corrupt
+            # bandwidth cap: deterministic token bucket on payload bytes;
+            # delays are monotone along a link (the bucket's free time only
+            # advances), so FIFO through the wheel is preserved
+            bw_delay = 0.0
+            if cfg.bandwidth_bps > 0.0:
+                now = time.monotonic()
+                start = max(now, self._bw_free.get(link, now))
+                done = start + payload_nbytes(msg) / cfg.bandwidth_bps
+                self._bw_free[link] = done
+                bw_delay = done - now
+                if bw_delay > 0.0:
+                    self.bandwidth_delays += 1
+        if (
+            not randomized
+            and slow == 0.0
+            and not corrupt_hit
+            and bw_delay <= 0.0
+        ):
             ok = self.inner.send(msg)
             with self._lock:
                 if ok:
@@ -278,7 +393,7 @@ class ChaosVan(VanWrapper):
                     self.unreachable_drops += 1
             return True
         copies = 1
-        latency = slow
+        latency = slow + bw_delay
         if randomized:
             if u_drop < cfg.drop:
                 with self._lock:
@@ -296,6 +411,12 @@ class ChaosVan(VanWrapper):
         if slow > 0.0:
             with self._lock:
                 self.injected_slow += 1
+        if corrupt_hit:
+            flipped = self._flip_bit(msg, crng)
+            if flipped is not None:
+                msg = flipped
+                with self._lock:
+                    self.injected_corrupt += 1
         if latency <= 0.0:
             # synchronous path: per-link FIFO preserved exactly (duplicates
             # arrive back to back, like an eager retransmitter)
@@ -329,6 +450,8 @@ class ChaosVan(VanWrapper):
                 "chaos_dups": self.injected_dups,
                 "chaos_reorders": self.injected_reorders,
                 "chaos_slow": self.injected_slow,
+                "chaos_corrupt": self.injected_corrupt,
+                "chaos_bw_delays": self.bandwidth_delays,
                 "chaos_partition_drops": self.partition_drops,
                 "chaos_unreachable": self.unreachable_drops,
             }

@@ -56,7 +56,9 @@ class StragglerPolicy:
 class _NodeSeries:
     """Retained per-node state: beat times + latest cumulative stats."""
 
-    __slots__ = ("beats", "resource", "prev_resource", "net", "prev_net")
+    __slots__ = (
+        "beats", "resource", "prev_resource", "net", "prev_net", "clock",
+    )
 
     def __init__(self, window: int) -> None:
         import collections
@@ -68,6 +70,9 @@ class _NodeSeries:
         self.prev_resource: dict = {}
         self.net: dict = {}
         self.prev_net: dict = {}
+        #: latest clock-sync estimate from Manager.sync_clock:
+        #: {"offset_s": local-minus-scheduler, "rtt_s": winning RTT}.
+        self.clock: dict = {}
 
 
 class FleetMonitor:
@@ -113,8 +118,40 @@ class FleetMonitor:
                 s.prev_resource, s.resource = s.resource, dict(stats["resource"])
             if stats.get("net"):
                 s.prev_net, s.net = s.net, dict(stats["net"])
+            if stats.get("clock"):
+                s.clock = dict(stats["clock"])
             for link, digest in (stats.get("links") or {}).items():
                 self._links[link] = digest
+
+    # -- clock offsets (cross-host latency attribution) ----------------------
+    def clock_offset(self, node_id: str) -> Optional[float]:
+        """``node_id``'s monotonic clock minus the scheduler's (seconds),
+        as last reported over heartbeat; None before its first sync.  The
+        scheduler itself is the reference: offset 0 by definition."""
+        with self._lock:
+            s = self._series.get(node_id)
+            if s is not None and "offset_s" in s.clock:
+                return float(s.clock["offset_s"])
+        return None
+
+    def relative_offset(self, a: str, b: str) -> Optional[float]:
+        """Clock of node ``a`` minus clock of node ``b`` (seconds).
+
+        This is the number a receiver needs to correct one-way deliver
+        latencies measured from ``__mts__`` stamps
+        (:class:`~parameter_server_tpu.core.netmon.MeteredVan.set_clock_offset`):
+        node-local monotonic clocks share no epoch across hosts, so the raw
+        ``recv_local - send_remote`` difference is offset + latency until
+        corrected.  None until BOTH nodes have synced (the scheduler counts
+        as always synced at 0).
+        """
+        from parameter_server_tpu.core.messages import SCHEDULER
+
+        off_a = 0.0 if a == SCHEDULER else self.clock_offset(a)
+        off_b = 0.0 if b == SCHEDULER else self.clock_offset(b)
+        if off_a is None or off_b is None:
+            return None
+        return off_a - off_b
 
     def nodes(self) -> List[str]:
         with self._lock:
@@ -168,6 +205,10 @@ class FleetMonitor:
                     row["wire_bytes_per_s"] = round(
                         (net["wire_bytes"] - pnet.get("wire_bytes", 0)) / dt, 1
                     )
+            if "offset_s" in s.clock:
+                row["clock_offset_ms"] = round(1e3 * s.clock["offset_s"], 3)
+                if s.clock.get("rtt_s") is not None:
+                    row["clock_rtt_ms"] = round(1e3 * s.clock["rtt_s"], 3)
             h = self._inbound_hist(links, node_id)
             if h.count:
                 row["push_p99_ms"] = round(1e3 * h.percentile(0.99), 3)

@@ -42,6 +42,7 @@ ADD_NODE = "add_node"
 REMOVE_NODE = "remove_node"
 HEARTBEAT = "heartbeat"
 BARRIER = "barrier"
+PING = "ping"
 
 
 @dataclasses.dataclass
@@ -56,6 +57,11 @@ class NodeInfo:
     #: wall time of the last heartbeat seen by the scheduler.
     last_seen: float = 0.0
     alive: bool = True
+    #: restart epoch of this node id (scheduler-assigned; bumped on every
+    #: re-registration under the same id).  Broadcast with the table so
+    #: every transport endpoint can fence frames from stale incarnations —
+    #: see ``core/resender.py``.
+    incarnation: int = 0
     #: (host, port) the node's Van listens on (multi-process TcpVan runs;
     #: None on an in-process LoopbackVan).  Broadcast with the table so
     #: every process can route to every other.
@@ -126,6 +132,10 @@ class Manager(Customer):
         #: scheduler-side sink for heartbeat stats (attach a
         #: ``core.fleet.FleetMonitor``); None = stats dropped as before.
         self.fleet = None
+        #: clock offset vs the scheduler (local minus scheduler monotonic,
+        #: seconds) + the RTT of the winning sample — set by sync_clock().
+        self.clock_offset: Optional[float] = None
+        self.clock_rtt: Optional[float] = None
         if self.role == NodeRole.SCHEDULER:
             self._register_self()
 
@@ -198,7 +208,68 @@ class Manager(Customer):
             self._on_heartbeat(msg)
         elif cmd == BARRIER:
             return self._on_barrier(msg)
+        elif cmd == PING:
+            return self._on_ping(msg)
         return msg.reply()
+
+    # -- clock sync (heartbeat-RTT/2 offset estimation) ----------------------
+    def _on_ping(self, msg: Message) -> Message:
+        import numpy as np
+
+        # reply carries the scheduler's monotonic clock reading; the pinger
+        # timestamps both legs locally and estimates its offset NTP-style
+        return msg.reply(
+            values=[np.asarray([time.monotonic()], np.float64)]
+        )
+
+    def sync_clock(
+        self, samples: int = 5, *, timeout: Optional[float] = 10.0
+    ) -> Optional[float]:
+        """Estimate this node's clock offset vs the scheduler (seconds).
+
+        Sends ``samples`` PINGs, timestamps both legs locally, and keeps the
+        minimum-RTT sample (least queueing noise): with the scheduler's
+        reading assumed to land mid-flight, ``offset = midpoint - sched``,
+        i.e. LOCAL minus SCHEDULER monotonic time.  The estimate (and the
+        winning RTT) ride subsequent heartbeats under ``stats["clock"]`` so
+        the fleet monitor (``core/fleet.py``) can correct cross-host
+        deliver-latency attribution from ``core/netmon.py`` — node-local
+        ``time.monotonic`` clocks share no epoch across processes, so raw
+        one-way latencies off loopback are meaningless without this.
+
+        Returns the offset, or None if every ping timed out (the previous
+        estimate, if any, is kept).
+        """
+        best: Optional[tuple[float, float]] = None  # (rtt, offset)
+        for _ in range(max(1, samples)):
+            t0 = time.monotonic()
+            ts = self.submit(
+                [
+                    Message(
+                        task=Task(
+                            TaskKind.CONTROL, self.name, payload={"cmd": PING}
+                        ),
+                        recver=SCHEDULER,
+                    )
+                ],
+                keep_responses=True,
+            )
+            ok = self.wait(ts, timeout=timeout)
+            if not ok:
+                self.cancel(ts, "clock ping deadline")
+            responses = self.take_responses(ts)
+            if not ok or not responses or not responses[0].values:
+                continue
+            t1 = time.monotonic()
+            sched = float(responses[0].values[0][0])
+            rtt = t1 - t0
+            offset = (t0 + t1) / 2.0 - sched
+            if best is None or rtt < best[0]:
+                best = (rtt, offset)
+        if best is None:
+            return None
+        self.clock_rtt, self.clock_offset = best
+        return self.clock_offset
 
     # -- barrier (poll-based; replies carry the arrival count) ---------------
     def _on_barrier(self, msg: Message) -> Message:
@@ -299,37 +370,86 @@ class Manager(Customer):
 
     def _on_register(self, msg: Message) -> None:
         assert self.role == NodeRole.SCHEDULER, "REGISTER sent to non-scheduler"
-        info = NodeInfo(
-            msg.sender, NodeRole(msg.task.payload["role"]),
-            last_seen=time.monotonic(),
-            address=msg.task.payload.get("address"),
-        )
-        addr = info.address
+        addr = msg.task.payload.get("address")
         if addr and hasattr(self.post.van, "add_route"):
             self.post.van.add_route(msg.sender, tuple(addr))
+        rejoin_row = None
         with self._table_lock:
-            self._table[msg.sender] = info
-            workers = sum(
-                1 for n in self._table.values() if n.role == NodeRole.WORKER
-            )
-            servers = sum(
-                1 for n in self._table.values() if n.role == NodeRole.SERVER
-            )
-            complete = workers >= self.num_workers and servers >= self.num_servers
-            if complete:
-                ranges = self.assigner.ranges(self.num_servers)
-                sids = sorted(
+            existing = self._table.get(msg.sender)
+            if existing is not None:
+                # Same-id restart: the scheduler is the incarnation
+                # authority.  Bump the epoch, keep the assigned key range
+                # (a restarted server still owns its shard), mark alive.
+                existing.incarnation += 1
+                existing.alive = True
+                existing.last_seen = time.monotonic()
+                if addr:
+                    existing.address = list(addr)
+                rejoin_row = dataclasses.asdict(existing)
+                table_rows = [
+                    dataclasses.asdict(n) for n in self._table.values()
+                ]
+                peers = [
                     n.node_id
                     for n in self._table.values()
-                    if n.role == NodeRole.SERVER
+                    if n.alive
+                    and n.node_id not in (self.post.node_id, msg.sender)
+                ]
+            else:
+                info = NodeInfo(
+                    msg.sender, NodeRole(msg.task.payload["role"]),
+                    last_seen=time.monotonic(),
+                    address=addr,
                 )
-                for sid, (b, e) in zip(sids, ranges):
-                    self._table[sid].range_begin = b
-                    self._table[sid].range_end = e
-            table_rows = [dataclasses.asdict(n) for n in self._table.values()]
+                self._table[msg.sender] = info
+                workers = sum(
+                    1 for n in self._table.values() if n.role == NodeRole.WORKER
+                )
+                servers = sum(
+                    1 for n in self._table.values() if n.role == NodeRole.SERVER
+                )
+                complete = (
+                    workers >= self.num_workers and servers >= self.num_servers
+                )
+                if complete:
+                    ranges = self.assigner.ranges(self.num_servers)
+                    sids = sorted(
+                        n.node_id
+                        for n in self._table.values()
+                        if n.role == NodeRole.SERVER
+                    )
+                    for sid, (b, e) in zip(sids, ranges):
+                        self._table[sid].range_begin = b
+                        self._table[sid].range_end = e
+                table_rows = [
+                    dataclasses.asdict(n) for n in self._table.values()
+                ]
+        if rejoin_row is not None:
+            # Fence first (locally), so any zombie frames still in flight
+            # under the old incarnation die at this endpoint too; then tell
+            # the fleet: peers get the one changed row, the restarted node
+            # gets the full table (it lost its copy with its memory).
+            self._learn_incarnation(msg.sender, rejoin_row["incarnation"])
+            self._broadcast_table(table_rows, [msg.sender])
+            if peers:
+                self._broadcast_table([rejoin_row], peers)
+            for cb in self.on_node_added:
+                cb(msg.sender)
+            return
         if complete:
             self._broadcast_table(table_rows)
             self._ready.set()
+
+    def _learn_incarnation(self, node_id: str, incarnation: int) -> None:
+        """Teach the local transport stack a node's incarnation.
+
+        Hasattr-guarded: delegates down the Van decorator chain to
+        ``ReliableVan.set_incarnation`` when one is present (a bare
+        LoopbackVan stack simply has no fencing to update).  Idempotent —
+        the registry only ever advances.
+        """
+        if incarnation and hasattr(self.post.van, "set_incarnation"):
+            self.post.van.set_incarnation(node_id, incarnation)
 
     def _broadcast_table(
         self, rows: list[dict], targets: Optional[list[str]] = None
@@ -351,12 +471,15 @@ class Manager(Customer):
             self.submit(msgs)
 
     def _on_add_node(self, msg: Message) -> None:
+        learned: list[tuple[str, int]] = []
         with self._table_lock:
             for row in msg.task.payload["table"]:
                 row = dict(row)
                 row["role"] = NodeRole(row["role"])
                 info = NodeInfo(**row)
                 self._table[info.node_id] = info
+                if info.incarnation:
+                    learned.append((info.node_id, info.incarnation))
                 # multi-process: learn routes to every peer from the table
                 if (
                     info.address
@@ -364,6 +487,10 @@ class Manager(Customer):
                     and hasattr(self.post.van, "add_route")
                 ):
                     self.post.van.add_route(info.node_id, tuple(info.address))
+        # outside the table lock: fence stale incarnations at this endpoint
+        # (and arm this node's own stamp if the row is about itself)
+        for node_id, inc in learned:
+            self._learn_incarnation(node_id, inc)
         for cb in self.on_node_added:
             for row in msg.task.payload["table"]:
                 cb(row["node_id"] if isinstance(row, dict) else row.node_id)
@@ -438,6 +565,14 @@ class Manager(Customer):
                 payload_stats.setdefault(
                     "net", transport_counters(self.post.van)
                 )
+                if self.clock_offset is not None:
+                    payload_stats.setdefault(
+                        "clock",
+                        {
+                            "offset_s": self.clock_offset,
+                            "rtt_s": self.clock_rtt,
+                        },
+                    )
                 metered = find_metered(self.post.van)
                 if metered is not None:
                     payload_stats.setdefault(

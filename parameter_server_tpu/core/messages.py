@@ -111,3 +111,52 @@ class TimestampGenerator:
     def next(self) -> int:
         with self._lock:
             return next(self._counter)
+
+
+#: payload key carrying the sender's incarnation (restart epoch) number.
+#: Stamped next to the per-link sequence (``core/resender.py``) so a node's
+#: transport identity is ``(node_id, incarnation, seq)``: a process that
+#: crashes and restarts under the SAME node id gets a higher incarnation,
+#: receivers reset their dedup windows for it, and frames from the dead
+#: pre-crash process (a "zombie") are fenced instead of corrupting state.
+INCARNATION_KEY = "__rinc__"
+
+
+class IncarnationRegistry:
+    """Thread-safe ``node_id -> incarnation`` table.
+
+    The scheduler (``core/manager.py``) is the authority that ASSIGNS
+    incarnations (re-registration under an existing id bumps it); every
+    transport endpoint keeps a registry like this as its local view — used
+    both to stamp outgoing frames from local nodes and to fence inbound
+    frames from stale incarnations of a peer.  Incarnations only ever
+    advance: ``learn`` ignores regressions (a delayed broadcast must never
+    re-open the fence).
+    """
+
+    def __init__(self) -> None:
+        self._inc: dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def get(self, node_id: str) -> int:
+        with self._lock:
+            return self._inc.get(node_id, 0)
+
+    def learn(self, node_id: str, incarnation: int) -> bool:
+        """Record ``incarnation`` for ``node_id``; True iff it advanced."""
+        with self._lock:
+            if incarnation <= self._inc.get(node_id, 0):
+                return False
+            self._inc[node_id] = incarnation
+            return True
+
+    def bump(self, node_id: str) -> int:
+        """Advance ``node_id``'s incarnation by one and return it."""
+        with self._lock:
+            inc = self._inc.get(node_id, 0) + 1
+            self._inc[node_id] = inc
+            return inc
+
+    def snapshot(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self._inc)

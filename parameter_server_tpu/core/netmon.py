@@ -13,8 +13,10 @@ mergeable :class:`~parameter_server_tpu.utils.trace.LatencyHistogram`\\ s:
   ``time.monotonic()`` into ``Task.payload`` on the way out and reading it
   in a receive wrapper on the way in (the ``__rseq__`` pattern of
   ``core/resender.py``).  Over an in-process Van both ends share a clock,
-  so this is true one-way latency; cross-host it inherits clock skew like
-  every one-way measurement does.
+  so this is true one-way latency; cross-host the raw difference embeds
+  clock skew — feed :meth:`MeteredVan.set_clock_offset` with the
+  heartbeat-RTT/2 estimates from ``Manager.sync_clock`` /
+  ``FleetMonitor.relative_offset`` to correct it.
 
 Stack position: OUTERMOST — ``MeteredVan(ReliableVan(ChaosVan(base)))`` —
 so each LOGICAL message is counted exactly once (retransmits, ACKs, and
@@ -87,6 +89,25 @@ class MeteredVan(VanWrapper):
         self._lock = threading.Lock()
         self._links: Dict[Tuple[str, str], _LinkStats] = {}
         self.undeliverable = 0
+        #: per-sender clock correction (seconds): sender's monotonic clock
+        #: minus the local receiver's, added to raw deliver latencies.
+        self._clock_offsets: Dict[str, float] = {}
+
+    def set_clock_offset(self, sender: str, offset_s: float) -> None:
+        """Correct deliver latencies for frames FROM ``sender``.
+
+        ``offset_s`` is the sender's monotonic clock minus this process's
+        (i.e. :meth:`~parameter_server_tpu.core.fleet.FleetMonitor.relative_offset`
+        of (sender, local node)).  Cross-host, ``recv_local - send_remote``
+        embeds that offset; adding it back yields true one-way latency, so
+        the gray-failure detector keeps working off loopback.  In-process
+        stacks share one clock and never need this (offset 0).
+        """
+        with self._lock:
+            if offset_s == 0.0:
+                self._clock_offsets.pop(sender, None)
+            else:
+                self._clock_offsets[sender] = offset_s
 
     def _link(self, sender: str, recver: str) -> _LinkStats:
         st = self._links.get((sender, recver))
@@ -137,8 +158,9 @@ class MeteredVan(VanWrapper):
                     ),
                 )
                 with self._lock:
+                    correction = self._clock_offsets.get(msg.sender, 0.0)
                     self._link(msg.sender, msg.recver).deliver.record(
-                        time.monotonic() - ts
+                        time.monotonic() - ts + correction
                     )
             handler(msg)
 

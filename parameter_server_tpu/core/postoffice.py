@@ -13,14 +13,28 @@ dispatch time via :class:`~parameter_server_tpu.core.clock.ConsistencyController
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import logging
 import threading
 from typing import Callable, Optional
 
-from parameter_server_tpu.core.messages import Message, TimestampGenerator
+from parameter_server_tpu.core.messages import (
+    Message,
+    Task,
+    TaskKind,
+    TimestampGenerator,
+)
 from parameter_server_tpu.core.van import Van
 from parameter_server_tpu.utils.threads import CALLBACKS
+
+#: pseudo-customer name of remote-cancellation control frames.  Intercepted
+#: by the Postoffice before customer lookup, so a CANCEL needs no executor
+#: and works even for customers that no longer exist on the receiver.
+CANCEL_CUSTOMER = "__cancel__"
+
+#: max remembered (origin, customer, ts) cancellation fences per node.
+_CANCEL_CAP = 1024
 
 
 class Postoffice:
@@ -30,6 +44,18 @@ class Postoffice:
         self.node_id = node_id
         self.van = van
         self._customers: dict[str, "Customer"] = {}
+        #: remote-cancellation fences: (origin, customer) -> cancelled ts
+        #: set, FIFO-evicted at _CANCEL_CAP total entries.  A fence placed
+        #: BEFORE the matching request arrives (the request leg was delayed
+        #: or is a retransmit racing its canceller) drops that request
+        #: instead of executing dead work — per-link FIFO means a cancel
+        #: never overtakes a request on a healthy link, so fences only
+        #: matter exactly when the request is late, which is the point.
+        self._cancelled: dict[tuple[str, str], set[int]] = {}
+        self._cancel_order: collections.deque = collections.deque()
+        self._cancel_lock = threading.Lock()
+        #: requests dropped because a cancellation fence matched.
+        self.cancelled_drops = 0
         van.bind(node_id, self._on_recv)
 
     def register(self, customer: "Customer") -> None:
@@ -41,7 +67,51 @@ class Postoffice:
         msg.sender = self.node_id
         return self.van.send(msg)
 
+    # -- remote cancellation -------------------------------------------------
+    def _on_cancel(self, msg: Message) -> None:
+        key = (msg.sender, msg.task.payload["customer"])
+        ts = int(msg.task.payload["time"])
+        with self._cancel_lock:
+            self._cancelled.setdefault(key, set()).add(ts)
+            self._cancel_order.append((key, ts))
+            while len(self._cancel_order) > _CANCEL_CAP:
+                old_key, old_ts = self._cancel_order.popleft()
+                fences = self._cancelled.get(old_key)
+                if fences is not None:
+                    fences.discard(old_ts)
+                    if not fences:
+                        del self._cancelled[old_key]
+
+    def _consume_cancel(self, sender: str, customer: str, ts: int) -> bool:
+        """True (once) if request ``ts`` from ``sender``/``customer`` was
+        remotely cancelled; the fence is consumed — ReliableVan dedups
+        duplicate deliveries below this layer, so one match is the most a
+        fence can ever see."""
+        with self._cancel_lock:
+            fences = self._cancelled.get((sender, customer))
+            if fences is None or ts not in fences:
+                return False
+            fences.discard(ts)
+            if not fences:
+                del self._cancelled[(sender, customer)]
+            return True
+
     def _on_recv(self, msg: Message) -> None:
+        if msg.is_request and msg.task.customer == CANCEL_CUSTOMER:
+            self._on_cancel(msg)
+            return  # fire-and-forget: the canceller already finalized
+        if msg.is_request and self._consume_cancel(
+            msg.sender, msg.task.customer, msg.task.time
+        ):
+            self.cancelled_drops += 1
+            logging.getLogger(__name__).info(
+                "%s: dropped cancelled request ts=%s from %s/%s",
+                self.node_id,
+                msg.task.time,
+                msg.sender,
+                msg.task.customer,
+            )
+            return
         customer = self._customers.get(msg.task.customer)
         if customer is None:
             # The reference glog-and-dropped here, which leaves the
@@ -111,6 +181,7 @@ class Customer:
         self._responses: dict[int, list[Message]] = {}
         self._errors: dict[int, list[str]] = {}
         self._responded: dict[int, set[str]] = {}  # senders already counted
+        self._receivers: dict[int, list[str]] = {}  # per-ts fan-out targets
         self._kept: set[int] = set()  # timestamps whose responses are retained
         self._executed: dict[str, int] = {}  # per-sender executed task time
         self._cond = threading.Condition()
@@ -137,6 +208,7 @@ class Customer:
         ts = self._ts.next()
         with self._cond:
             self._pending[ts] = len(msgs)
+            self._receivers[ts] = [m.recver for m in msgs]
             if keep_responses or callback is not None:
                 self._responses[ts] = []
             if callback is not None:
@@ -188,7 +260,9 @@ class Customer:
             return self.done(ts)
         return self.wait(ts, timeout)
 
-    def cancel(self, ts: int, reason: str = "cancelled") -> bool:
+    def cancel(
+        self, ts: int, reason: str = "cancelled", *, remote: bool = False
+    ) -> bool:
         """Finalize a still-pending task ``ts`` with an error.
 
         A timed-out :meth:`wait` used to leave the task pending forever —
@@ -199,13 +273,44 @@ class Customer:
         kept tasks), late responses are ignored by the existing
         duplicate-response guard, and all bookkeeping is freed by the normal
         completion path.  Returns False if ``ts`` already completed.
+
+        ``remote=True`` additionally sends a fire-and-forget CANCEL control
+        frame to every receiver that has not yet responded, so a delayed or
+        retransmitted request leg is DROPPED there instead of executing dead
+        work (the reference ran abandoned tasks to completion).  Callers
+        about to re-submit the same work (deadline-retry paths) should use
+        it: without the fence, the original and the retry can both execute —
+        for pushes that is a double-apply.  Off by default because some
+        abandoned work must still run remotely (a sync-replica forward that
+        the primary already applied must reach the replica eventually, or
+        the chain diverges).
         """
         with self._cond:
             if ts not in self._pending:
                 return False
+            targets = []
+            if remote:
+                responded = self._responded.get(ts, set())
+                targets = [
+                    r
+                    for r in self._receivers.get(ts, [])
+                    if r not in responded
+                ]
             self._errors.setdefault(ts, []).append(reason)
             self._finish_locked(ts)
-            return True
+        for recver in targets:
+            self.post.send(
+                Message(
+                    task=Task(
+                        TaskKind.CONTROL,
+                        CANCEL_CUSTOMER,
+                        time=ts,
+                        payload={"customer": self.name, "time": ts},
+                    ),
+                    recver=recver,
+                )
+            )
+        return True
 
     def done(self, ts: int) -> bool:
         with self._cond:
@@ -263,6 +368,7 @@ class Customer:
     def _finish_locked(self, ts: int) -> None:
         del self._pending[ts]
         self._responded.pop(ts, None)
+        self._receivers.pop(ts, None)
         cb = self._callbacks.pop(ts, None)
         if ts in self._kept:
             responses = self._responses.get(ts, [])

@@ -37,6 +37,28 @@ Dedup state is keyed by link, not by endpoint object, so a promoted standby
 binding the dead primary's node id inherits the link's seq/window history
 (same Van instance in-process); on a cross-process TcpVan promotion is a
 route update and each process keeps its own windows.
+
+Same-id restart (incarnation fencing): a process that crashes and restarts
+UNDER THE SAME node id cannot reuse the link's seq space — its fresh seq 0
+would read as a duplicate to every peer's seen-window, and its stale
+pre-crash twin (a zombie that is slow to die) could keep emitting frames
+that corrupt the successor's state.  Every frame therefore also carries the
+sender's **incarnation** (:data:`~parameter_server_tpu.core.messages.
+INCARNATION_KEY`, assigned by the scheduler on re-registration): receivers
+key dedup windows by ``(link, incarnation)``, reset the window when a
+peer's incarnation advances, and FENCE (drop + count ``rejected_stale``,
+no ACK) frames from any lower incarnation.  ACKs echo ``(seq, inc)`` so a
+zombie's ACK can never clear the successor's pending entries.
+:meth:`ReliableVan.restart_node` is the local half of the lifecycle: it
+resets the restarted node's outbound seq counters (the new process starts
+at 0 under the new incarnation) and drops the dead process's unacked sends.
+
+Integrity: each data frame is stamped with a CRC32 over its key/value bytes
+(``__rcrc__``); a receiver that computes a different digest drops the frame
+WITHOUT acking (``rejected_corrupt``), so the sender's normal retransmit
+path repairs in-flight payload corruption (ChaosVan bit-flips, bad NICs)
+exactly like loss.  Disable with ``integrity=False`` for stacks whose
+base-van filter chain is intentionally lossy (int8 quantization).
 """
 
 from __future__ import annotations
@@ -46,19 +68,55 @@ import logging
 import random
 import threading
 import time
+import zlib
 from typing import Callable, Dict, Optional, Tuple
 
-from parameter_server_tpu.core.messages import Message, Task, TaskKind
+import numpy as np
+
+from parameter_server_tpu.core.messages import (
+    INCARNATION_KEY,
+    IncarnationRegistry,
+    Message,
+    Task,
+    TaskKind,
+)
 from parameter_server_tpu.core.van import Van, VanWrapper
 
 #: payload key carrying the per-link sequence stamp.
 SEQ_KEY = "__rseq__"
 #: payload key carrying the acked sequence number in ACK frames.
 ACK_KEY = "__rack__"
+#: payload key carrying the CRC32 of the frame's key/value bytes.
+CRC_KEY = "__rcrc__"
 #: customer name of ACK frames; intercepted below the Postoffice.
 ACK_CUSTOMER = "__resender__"
+#: payload keys stripped before a frame is delivered to the Postoffice.
+_STAMP_KEYS = (SEQ_KEY, INCARNATION_KEY, CRC_KEY)
 
 _log = logging.getLogger(__name__)
+
+
+def payload_crc32(msg: Message) -> int:
+    """CRC32 over the frame's key bytes and every value array's bytes.
+
+    Covers exactly what in-flight corruption can touch and what the wire
+    moves (tensor payloads); Task metadata is excluded on purpose — upper
+    layers (netmon stamps, trace ctx) legitimately rewrite the payload dict
+    between send and delivery.
+
+    Device-resident values (``jax.Array``) are skipped on both ends: over
+    an in-process Van they are delivered by reference (nothing on the wire
+    to corrupt) and hashing them would force the device sync that
+    ``push_device`` exists to avoid.  The skip decision is type-based, so
+    sender and receiver agree on what was covered.
+    """
+    crc = 0
+    if isinstance(msg.keys, np.ndarray):
+        crc = zlib.crc32(np.ascontiguousarray(msg.keys).tobytes(), crc)
+    for v in msg.values:
+        if isinstance(v, np.ndarray):
+            crc = zlib.crc32(np.ascontiguousarray(v).tobytes(), crc)
+    return crc & 0xFFFFFFFF
 
 
 class _SeenWindow:
@@ -118,6 +176,7 @@ class ReliableVan(VanWrapper):
         max_retries: int = 10,
         window: int = 4096,
         seed: int = 0,
+        integrity: bool = True,
         on_give_up: Optional[Callable[[Message], None]] = None,
     ) -> None:
         super().__init__(inner)
@@ -126,11 +185,14 @@ class ReliableVan(VanWrapper):
         self.jitter = jitter
         self.max_retries = max_retries
         self.window = window
+        self.integrity = integrity
         self.on_give_up = on_give_up
         self._rng = random.Random(seed)
         self._next_seq: Dict[Tuple[str, str], int] = {}
-        self._pending: Dict[Tuple[Tuple[str, str], int], _Pending] = {}
+        self._pending: Dict[Tuple[Tuple[str, str], int, int], _Pending] = {}
         self._windows: Dict[Tuple[str, str], _SeenWindow] = {}
+        #: node_id -> incarnation: stamps local sends, fences inbound frames.
+        self.incarnations = IncarnationRegistry()
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
         self._closed = False
@@ -140,6 +202,10 @@ class ReliableVan(VanWrapper):
         self.gave_up = 0
         self.acks_sent = 0
         self.acks_received = 0
+        #: frames dropped by the incarnation fence (zombie senders).
+        self.rejected_stale = 0
+        #: frames dropped by the CRC32 integrity check (bit-flips in flight).
+        self.rejected_corrupt = 0
         self._thread = threading.Thread(
             target=self._retransmit_loop, name="resender-retx", daemon=True
         )
@@ -160,9 +226,31 @@ class ReliableVan(VanWrapper):
             if seq is None:
                 handler(msg)  # unstamped (foreign/legacy) traffic
                 return
+            inc = msg.task.payload.get(INCARNATION_KEY, 0)
+            known = self.incarnations.get(msg.sender)
+            if inc < known:
+                # Incarnation fence: a frame from a dead pre-restart process
+                # (zombie).  Dropped WITHOUT an ACK — the zombie's resender
+                # exhausts its budget into the void; acking would tell a
+                # dead process its corruption landed.
+                with self._lock:
+                    self.rejected_stale += 1
+                return
+            crc = msg.task.payload.get(CRC_KEY)
+            if crc is not None and self.integrity:
+                if payload_crc32(msg) != crc:
+                    # corrupted in flight: no ACK, so the sender's verbatim
+                    # retransmit (its copy is intact) repairs it like a loss
+                    with self._lock:
+                        self.rejected_corrupt += 1
+                    return
             link = (msg.sender, msg.recver)
+            if inc > known and self.incarnations.learn(msg.sender, inc):
+                # peer restarted: its new process counts seqs from 0 again —
+                # reset every window keyed to the old incarnation's seq space
+                self._reset_sender_windows(msg.sender)
             # ACK before processing: the sender's clock starts at *its* send
-            self._send_ack(msg, seq)
+            self._send_ack(msg, seq, inc)
             with self._lock:
                 win = self._windows.get(link)
                 if win is None:
@@ -172,8 +260,8 @@ class ReliableVan(VanWrapper):
                     self.dup_suppressed += 1
             if not is_fresh:
                 return
-            # strip the stamp: replies share this Task's payload dict, and a
-            # stale inherited seq would corrupt the reply link's dedup
+            # strip the stamps: replies share this Task's payload dict, and
+            # a stale inherited seq would corrupt the reply link's dedup
             clean = dataclasses.replace(
                 msg,
                 task=dataclasses.replace(
@@ -181,7 +269,7 @@ class ReliableVan(VanWrapper):
                     payload={
                         k: v
                         for k, v in msg.task.payload.items()
-                        if k != SEQ_KEY
+                        if k not in _STAMP_KEYS
                     },
                 ),
             )
@@ -189,10 +277,18 @@ class ReliableVan(VanWrapper):
 
         return wrapped
 
-    def _send_ack(self, msg: Message, seq: int) -> None:
+    def _reset_sender_windows(self, sender: str) -> None:
+        """Drop dedup windows for every link originated by ``sender``."""
+        with self._lock:
+            for link in [l for l in self._windows if l[0] == sender]:
+                del self._windows[link]
+
+    def _send_ack(self, msg: Message, seq: int, inc: int) -> None:
         ack = Message(
             task=Task(
-                TaskKind.CONTROL, ACK_CUSTOMER, payload={ACK_KEY: seq}
+                TaskKind.CONTROL,
+                ACK_CUSTOMER,
+                payload={ACK_KEY: seq, INCARNATION_KEY: inc},
             ),
             sender=msg.recver,
             recver=msg.sender,
@@ -208,28 +304,35 @@ class ReliableVan(VanWrapper):
         # ack for link (our node, peer): msg travelled peer -> us
         link = (msg.recver, msg.sender)
         seq = msg.task.payload.get(ACK_KEY)
+        inc = msg.task.payload.get(INCARNATION_KEY, 0)
         with self._lock:
             self.acks_received += 1
-            self._pending.pop((link, seq), None)
+            # keyed by (link, inc, seq): an ACK echoing a stale incarnation
+            # (a zombie receiver acking pre-restart traffic) cannot clear a
+            # successor incarnation's pending entry of the same seq
+            self._pending.pop((link, inc, seq), None)
 
     # -- send side -----------------------------------------------------------
     def send(self, msg: Message) -> bool:
         if self._closed:
             return False
         link = (msg.sender, msg.recver)
+        inc = self.incarnations.get(msg.sender)
         with self._lock:
             seq = self._next_seq.get(link, 0)
             self._next_seq[link] = seq + 1
+        payload = {**msg.task.payload, SEQ_KEY: seq}
+        if inc:
+            payload[INCARNATION_KEY] = inc
+        if self.integrity:
+            payload[CRC_KEY] = payload_crc32(msg)
         stamped = dataclasses.replace(
-            msg,
-            task=dataclasses.replace(
-                msg.task, payload={**msg.task.payload, SEQ_KEY: seq}
-            ),
+            msg, task=dataclasses.replace(msg.task, payload=payload)
         )
         if not self.inner.send(stamped):
             return False  # fail-fast: see module docstring
         with self._wake:
-            self._pending[(link, seq)] = _Pending(
+            self._pending[(link, inc, seq)] = _Pending(
                 stamped, link, seq, attempts=0,
                 due=time.monotonic() + self._deadline(0),
             )
@@ -283,6 +386,60 @@ class ReliableVan(VanWrapper):
                     except Exception:  # noqa: BLE001 — user hook
                         _log.exception("resender: on_give_up hook failed")
 
+    # -- same-id restart lifecycle -------------------------------------------
+    def set_incarnation(self, node_id: str, incarnation: int) -> bool:
+        """Learn ``node_id``'s (possibly new) incarnation; True iff advanced.
+
+        Called on every node when the scheduler broadcasts a bumped
+        ``(id, incarnation)`` binding.  On an advance: frames still in
+        flight from the node's PREVIOUS incarnation become stale (fenced at
+        receivers), local sends from the node stamp the new incarnation and
+        restart seq at 0 (the new process's counter), the dead process's
+        unacked sends are dropped (their ACKs will never come), and dedup
+        windows for links FROM the node reset so the fresh seq space is not
+        eaten by pre-crash history.  Sends TO the node keep retransmitting
+        untouched — they land on the restarted process, which dedups them
+        against its recovered window state (see :meth:`drop_inbound_state`).
+        """
+        if not self.incarnations.learn(node_id, incarnation):
+            return False
+        self._reset_sender_windows(node_id)
+        with self._lock:
+            for link in [l for l in self._next_seq if l[0] == node_id]:
+                del self._next_seq[link]
+            for key in [k for k in self._pending if k[0][0] == node_id]:
+                del self._pending[key]
+        return True
+
+    def restart_node(self, node_id: str) -> int:
+        """Local-authority restart: bump ``node_id``'s incarnation in place.
+
+        For tests and single-process clusters without a Manager; clusters
+        with a scheduler should re-register instead (the Manager is the
+        incarnation authority) and let the broadcast reach
+        :meth:`set_incarnation`.  Returns the new incarnation.
+        """
+        inc = self.incarnations.get(node_id) + 1
+        self.set_incarnation(node_id, inc)
+        return inc
+
+    def drop_inbound_state(self, node_id: str) -> None:
+        """Forget dedup windows for links INTO ``node_id``.
+
+        Models what a real crash loses at the RECEIVER: the restarted
+        process has no memory of which peer seqs it already applied, so a
+        pre-crash frame retransmitted into it re-delivers.  Call this on
+        the checkpoint-fallback restore path (state rewound anyway —
+        re-applies land inside the accepted rewind window).  The replica
+        restore path must NOT call it: a sync chain forwards every applied
+        push before acking, so "applied set == window content" — keeping
+        the windows IS recovering the dedup state from the chain, and it
+        is what makes same-id restart exactly-once end to end.
+        """
+        with self._lock:
+            for link in [l for l in self._windows if l[1] == node_id]:
+                del self._windows[link]
+
     # -- introspection / lifecycle -------------------------------------------
     def inflight(self) -> int:
         """Number of sends still awaiting an ACK."""
@@ -306,6 +463,8 @@ class ReliableVan(VanWrapper):
                 "gave_up": self.gave_up,
                 "acks_sent": self.acks_sent,
                 "acks_received": self.acks_received,
+                "rejected_stale": self.rejected_stale,
+                "rejected_corrupt": self.rejected_corrupt,
             }
 
     def close(self) -> None:

@@ -26,7 +26,8 @@ already crosses sockets unchanged; only the rebind differs.
 
 from __future__ import annotations
 
-from typing import Dict
+import logging
+from typing import Callable, Dict, Optional
 
 from parameter_server_tpu.config import TableConfig
 from parameter_server_tpu.core.postoffice import Postoffice
@@ -111,6 +112,99 @@ def promote(van: Van, standby: KVServer, primary_id: str) -> KVServer:
     if reconnect is not None:
         reconnect(primary_id)
     return standby
+
+
+def restart_same_id(
+    van: Van,
+    table_cfgs: Dict[str, TableConfig],
+    server_index: int,
+    num_servers: int,
+    *,
+    standby: Optional[KVServer] = None,
+    ckpt_root: Optional[str] = None,
+    register: Optional[Callable[[Postoffice], None]] = None,
+    device_replies: bool = False,
+    replica_sync: bool = True,
+    max_lag: int = 8,
+) -> tuple[KVServer, str]:
+    """Bring ``S{server_index}`` back under its OWN node id after a crash.
+
+    The same-id restart lifecycle (ISSUE: incarnation-fenced restart, the
+    production alternative to :func:`promote`'s id takeover):
+
+    1. the dead process's endpoints (``S{i}`` and its ``S{i}.fw`` forwarding
+       client) are unbound defensively and the identity stays DISCONNECTED
+       while state restores — a worker retransmit landing on a cold table
+       that an import then overwrites would be an acked-but-lost update;
+    2. a fresh :class:`KVServer` is built (same index ⇒ same row range and
+       deterministic init seed), then its shard restores from the live
+       ``standby`` (:meth:`KVServer.export_shard`, preferred: bit-identical
+       including optimizer state, ZERO loss under a sync chain) or from the
+       latest committed checkpoint in ``ckpt_root`` (fallback: bounded
+       rewind ≤ the checkpoint interval).  With neither the shard re-inits
+       cold (the deterministic seed at least keeps restarts reproducible);
+    3. dedup windows INTO ``S{i}`` are kept on the replica path — a sync
+       chain's applied-set equals the windows' content, so the preserved
+       windows ARE the recovered exactly-once state (a pre-crash push whose
+       ACK was lost is deduped, and its effect arrives via the import).  On
+       the checkpoint/cold paths the windows LIE (they claim delivery of
+       effects the rewind lost), so ``drop_inbound_state`` clears them and
+       still-retransmitting frames re-apply inside the accepted rewind;
+    4. the identity reconnects and ``register`` (when given) re-registers
+       with the scheduler, which bumps the node's incarnation and broadcasts
+       the new binding — peers reset seq windows for frames FROM ``S{i}``
+       and fence any zombie frames of the dead process.
+
+    Returns ``(server, source)`` with source in {"replica", "checkpoint",
+    "cold"}.  The new server re-chains to the standby's id when a standby
+    is passed, so protection continues after the restart.
+    """
+    primary_id = f"S{server_index}"
+    for nid in (primary_id, f"{primary_id}.fw"):
+        try:
+            van.unbind(nid)
+        except Exception:  # noqa: BLE001 — already unbound is the normal case
+            pass
+    # keep the identity dark while restoring (see docstring step 1); vans
+    # without disconnect() are in-process test stacks where the caller
+    # controls traffic, so the guard degrades safely
+    disconnect = getattr(van, "disconnect", None)
+    if disconnect is not None:
+        disconnect(primary_id)
+    server = KVServer(
+        Postoffice(primary_id, van),
+        table_cfgs,
+        server_index,
+        num_servers,
+        device_replies=device_replies,
+        replica=None if standby is None else standby.post.node_id,
+        replica_sync=replica_sync,
+        max_replica_lag=max_lag,
+    )
+    if standby is not None:
+        server.import_shard(standby.export_shard())
+        source = "replica"
+    else:
+        from parameter_server_tpu import checkpoint
+
+        step = None if ckpt_root is None else checkpoint.latest_step(ckpt_root)
+        if step is not None:
+            server.restore_checkpoint(ckpt_root, step)
+            source = "checkpoint"
+        else:
+            source = "cold"
+        if hasattr(van, "drop_inbound_state"):
+            van.drop_inbound_state(primary_id)
+    logging.getLogger(__name__).info(
+        "restart_same_id: %s restored from %s", primary_id, source
+    )
+    for nid in (primary_id, f"{primary_id}.fw"):
+        reconnect = getattr(van, "reconnect", None)
+        if reconnect is not None:
+            reconnect(nid)
+    if register is not None:
+        register(server.post)
+    return server, source
 
 
 class ReplicaSet:

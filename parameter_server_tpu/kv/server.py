@@ -190,6 +190,37 @@ class KVServer(Customer):
             return msg.reply(values=[np.asarray(rows)[:n]])
         raise ValueError(f"unsupported task kind {msg.task.kind}")
 
+    # -- shard transfer (same-id restart: kv/replica.restart_same_id) --------
+    def export_shard(self) -> Dict[str, dict]:
+        """Host-side snapshot of every table shard: value + optimizer state.
+
+        The live-donor half of same-id restart recovery: a hot standby
+        exports, the restarted primary imports, and the pair is bit-identical
+        — including optimizer accumulators, which the wire protocol never
+        carries (only the chain forwarding replays them).
+        """
+        return {
+            t: {
+                "value": np.asarray(table.value),
+                "state": {k: np.asarray(v) for k, v in table.state.items()},
+            }
+            for t, table in self.tables.items()
+        }
+
+    def import_shard(self, shard: Dict[str, dict]) -> None:
+        """Adopt an :meth:`export_shard` snapshot wholesale.
+
+        Row ranges must match (same ``server_index``/``num_servers``); the
+        donated push buffers are simply replaced, so the next push jit-step
+        runs on the imported arrays.
+        """
+        for t, blob in shard.items():
+            table = self.tables[t]
+            table.value = jnp.asarray(blob["value"])
+            table.state = {
+                k: jnp.asarray(v) for k, v in blob["state"].items()
+            }
+
     # -- checkpoint (reference SaveModel task: servers write their key-range
     # to file; src/app/linear_method/model_evaluation.h [U]) -----------------
     def _handle_control(self, msg: Message) -> Message:

@@ -260,7 +260,10 @@ class KVWorker(Customer):
             completed = self.wait(ts, timeout)
         if not completed and self.retry_on_timeout:
             plan = self._pull_plans.pop(ts)
-            self.cancel(ts, "pull deadline")  # frees _pending; late/retx
+            # remote=True fences the dead pull at servers whose request leg
+            # is still in flight — they drop it instead of computing a reply
+            # nobody will read
+            self.cancel(ts, "pull deadline", remote=True)
             self.take_responses(ts)  # responses of the dead task: drained
             self.pull_retries += 1
             ts = self._submit_pull(
@@ -354,7 +357,11 @@ class KVWorker(Customer):
             return ts
         if not self.retry_on_timeout:
             raise TimeoutError(f"push ts={ts} timed out")
-        self.cancel(ts, "push deadline")
+        # remote=True: servers that have not applied the original yet DROP
+        # it, closing the original+retry double-apply window that the
+        # docstring's transport argument alone cannot (a delayed request
+        # leg is not a retransmit, so ReliableVan dedup never sees it)
+        self.cancel(ts, "push deadline", remote=True)
         self.push_retries += 1
         ts = self.push(table, keys, values)
         if not self.wait(ts, timeout):

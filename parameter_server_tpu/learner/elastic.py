@@ -20,7 +20,14 @@ mirroring the reference's composition (heartbeats -> Manager REMOVE_NODE ->
   registered on the scheduler's manager promotes it on the same
   ``on_node_dead`` signal this trainer uses (the reference paper's §4.3
   replication, absent from the open tree).  Snapshot restore remains the
-  fallback for un-replicated shards.
+  fallback for un-replicated shards;
+- a crashed server process restarted IN PLACE (same node id) goes through
+  :func:`restart_server` → :func:`parameter_server_tpu.kv.replica.restart_same_id`:
+  shard restored from the standby (zero loss) or checkpoint (bounded
+  rewind), then re-registration with the scheduler, which bumps the node's
+  transport incarnation so peers fence the dead process's zombie frames
+  (``core/resender.py``) — workers resume against the same ``S{i}``
+  identity without promotion or trajectory rewind.
 
 The trainer is Van-agnostic: fault injection in tests uses
 ``LoopbackVan.disconnect`` (a dead socket) + a forced heartbeat sweep, and the
@@ -292,3 +299,60 @@ def recover_server(
     server = make_server()
     server.restore_checkpoint(ckpt_root, step)
     return server
+
+
+def restart_server(
+    van,
+    table_cfgs,
+    server_index: int,
+    num_servers: int,
+    *,
+    num_workers: int,
+    standby=None,
+    ckpt_root: Optional[str] = None,
+    heartbeat_timeout: float = 5.0,
+    register_timeout: Optional[float] = 30.0,
+    **server_kw,
+):
+    """Full same-id crash-restart lifecycle for server ``S{server_index}``.
+
+    Thin composition over
+    :func:`parameter_server_tpu.kv.replica.restart_same_id` that also runs
+    the membership half: a fresh :class:`~parameter_server_tpu.core.manager.Manager`
+    on the restarted node re-registers with the scheduler, which — seeing an
+    existing row for the id — bumps the node's incarnation and broadcasts
+    the new binding, fencing the dead process's in-flight frames fleet-wide.
+
+    Restore preference is ``standby`` (zero loss) > ``ckpt_root`` (rewind
+    bounded by the checkpoint interval) > cold.  Returns
+    ``(server, source, manager)``.
+    """
+    from parameter_server_tpu.core.manager import Manager
+    from parameter_server_tpu.kv.replica import restart_same_id
+
+    restarted: dict = {}
+
+    def register(post) -> None:
+        mgr = Manager(
+            post,
+            num_workers=num_workers,
+            num_servers=num_servers,
+            heartbeat_timeout=heartbeat_timeout,
+        )
+        restarted["manager"] = mgr
+        if not mgr.register_with_scheduler(register_timeout):
+            raise TimeoutError(
+                f"restarted {post.node_id} never saw the table broadcast"
+            )
+
+    server, source = restart_same_id(
+        van,
+        table_cfgs,
+        server_index,
+        num_servers,
+        standby=standby,
+        ckpt_root=ckpt_root,
+        register=register,
+        **server_kw,
+    )
+    return server, source, restarted.get("manager")

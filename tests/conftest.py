@@ -34,3 +34,40 @@ try:  # unregister the axon PJRT plugin factory if sitecustomize added it
             _xb._backend_factories.pop(_name, None)
 except Exception:
     pass
+
+import pytest
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    """On any failing ``chaos``-marked test, print the seed(s) involved.
+
+    Seeded chaos runs are deterministic given (seed, send order), so a CI
+    failure should be a one-liner to reproduce locally — but only if the
+    seed makes it into the failure output.  Parametrized seeds come from
+    ``item.callspec``; tests with hardcoded seeds can instead stash one via
+    ``item.user_properties.append(("chaos_seed", seed))``.
+    """
+    outcome = yield
+    report = outcome.get_result()
+    if report.when != "call" or not report.failed:
+        return
+    if "chaos" not in item.keywords:
+        return
+    seeds = {}
+    params = getattr(item, "callspec", None)
+    if params is not None:
+        for name, value in params.params.items():
+            if "seed" in name.lower():
+                seeds[name] = value
+    for name, value in item.user_properties:
+        if "seed" in name.lower():
+            seeds[name] = value
+    repro = f"pytest '{item.nodeid}'"
+    detail = (
+        f"chaos seeds: {seeds}" if seeds
+        else "chaos seeds: (none recorded — check the test's literals)"
+    )
+    report.sections.append(
+        ("chaos repro", f"{detail}\nrepro: {repro}")
+    )

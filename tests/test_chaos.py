@@ -658,3 +658,146 @@ def test_stress_sweep_heavy_chaos(seed):
         assert van.gave_up == 0
     finally:
         van.close()
+
+
+# ----------------------------------------------- payload corruption (CRC)
+
+
+def test_corrupt_frames_rejected_and_retransmit_recovers():
+    """30% in-flight bit-flips: the receiver's CRC check rejects every
+    corrupted frame WITHOUT acking it, the sender retransmits from its
+    pristine buffer, and every RPC completes with intact values."""
+    van, chaos = _reliable_stack(seed=2, timeout=0.02, corrupt=0.3)
+    try:
+        Echo("echo", Postoffice("S0", van))
+        client = Customer("echo", Postoffice("W0", van))
+        for i in range(30):
+            ts = client.submit(
+                [Message(task=Task(TaskKind.PUSH, "echo"), recver="S0",
+                         values=[np.arange(8, dtype=np.float64) + i])],
+                keep_responses=True,
+            )
+            assert client.wait(ts, timeout=60), f"rpc {i} never completed"
+            (resp,) = client.take_responses(ts)
+            np.testing.assert_array_equal(
+                resp.values[0], 2.0 * (np.arange(8, dtype=np.float64) + i)
+            )
+        assert chaos.injected_corrupt > 0  # flips actually happened
+        assert van.rejected_corrupt > 0  # ...and the CRC caught them
+        assert van.retransmits > 0  # ...and retransmission repaired them
+        assert van.gave_up == 0
+        assert van.flush(10)
+    finally:
+        van.close()
+
+
+def test_corruption_never_mutates_sender_buffer():
+    """The bit-flip lands in a COPY: the sender's array (the resender's
+    retransmit source) must stay pristine, or recovery would retransmit
+    the corruption itself."""
+    chaos = ChaosVan(LoopbackVan(), seed=0)
+    try:
+        chaos.set_link("A", "B", ChaosConfig(corrupt=1.0))
+        got = []
+        chaos.bind("B", got.append)
+        original = np.arange(64, dtype=np.float32)
+        pristine = original.copy()
+        chaos.send(
+            Message(task=Task(TaskKind.CONTROL, "x", time=0),
+                    sender="A", recver="B", values=[original])
+        )
+        assert _settle(lambda: len(got) == 1)
+        assert chaos.injected_corrupt == 1
+        np.testing.assert_array_equal(original, pristine)  # sender untouched
+        delivered = got[0].values[0]
+        assert not np.array_equal(
+            delivered.view(np.uint8), pristine.view(np.uint8)
+        )  # exactly one bit differs on the wire copy
+        diff = np.unpackbits(
+            delivered.view(np.uint8) ^ pristine.view(np.uint8)
+        ).sum()
+        assert diff == 1
+    finally:
+        chaos.close()
+
+
+def test_corruption_rng_isolated_from_fault_schedule():
+    """Corruption draws come from a SEPARATE per-link RNG stream: enabling
+    ``corrupt`` on a link must not shift that link's seeded drop schedule
+    (messages with no numpy payload can't flip, but the schedule contract
+    holds for payload-bearing traffic too)."""
+    def drops_on_ab(corrupt):
+        chaos = ChaosVan(LoopbackVan(), seed=5)
+        try:
+            chaos.set_link(
+                "A", "B", ChaosConfig(drop=0.3, corrupt=0.9 if corrupt else 0.0)
+            )
+            chaos.bind("B", lambda m: None)
+            for i in range(100):
+                chaos.send(
+                    Message(task=Task(TaskKind.CONTROL, "x", time=i),
+                            sender="A", recver="B",
+                            values=[np.arange(4, dtype=np.float32)])
+                )
+            if corrupt:
+                assert _settle(lambda: chaos.injected_corrupt > 0)
+            return chaos.injected_drops
+        finally:
+            chaos.close()
+
+    assert drops_on_ab(False) == drops_on_ab(True) > 0
+
+
+# ------------------------------------------------------ bandwidth capping
+
+
+def test_bandwidth_cap_delays_and_preserves_fifo():
+    """A capped link delays each delivery by its serialization time on a
+    per-link virtual transmit clock; order stays FIFO and the counter
+    records every capped delivery."""
+    chaos = ChaosVan(LoopbackVan(), seed=0)
+    try:
+        # 10 KB/s cap, 1 KB messages -> 0.1 s serialization each
+        chaos.set_link("A", "B", ChaosConfig(bandwidth_bps=10_000.0))
+        got = []
+        chaos.bind("B", lambda m: got.append(m.task.time))
+        t0 = time.perf_counter()
+        for i in range(5):
+            chaos.send(
+                Message(task=Task(TaskKind.CONTROL, "x", time=i),
+                        sender="A", recver="B",
+                        values=[np.zeros(1000, dtype=np.uint8)])
+            )
+        assert _settle(lambda: len(got) == 5)
+        elapsed = time.perf_counter() - t0
+        assert elapsed >= 0.4  # 5 back-to-back transmissions at 0.1 s each
+        assert got == [0, 1, 2, 3, 4]  # token bucket is FIFO
+        assert chaos.bandwidth_delays == 5
+    finally:
+        chaos.close()
+
+
+def test_bandwidth_cap_is_draw_free():
+    """The token bucket consumes ZERO RNG draws: capping a link leaves its
+    seeded drop schedule bit-identical."""
+    def drops_on_ab(capped):
+        chaos = ChaosVan(LoopbackVan(), seed=5)
+        try:
+            cfg = ChaosConfig(
+                drop=0.3, bandwidth_bps=1e9 if capped else 0.0
+            )
+            chaos.set_link("A", "B", cfg)
+            chaos.bind("B", lambda m: None)
+            for i in range(100):
+                chaos.send(
+                    Message(task=Task(TaskKind.CONTROL, "x", time=i),
+                            sender="A", recver="B",
+                            values=[np.zeros(100, dtype=np.uint8)])
+                )
+            if capped:
+                assert chaos.bandwidth_delays > 0
+            return chaos.injected_drops
+        finally:
+            chaos.close()
+
+    assert drops_on_ab(False) == drops_on_ab(True) > 0

@@ -284,3 +284,44 @@ def test_slow_node_heals_and_flags_clear_on_fresh_monitor():
         assert time.perf_counter() - t1 < 0.05
     finally:
         van.close()
+
+
+def test_clock_stats_ingest_and_relative_offset():
+    """Heartbeat ``clock`` stats land in the per-node series; offsets are
+    relative to the scheduler (0 by definition) and pairwise offsets are
+    the difference of the two estimates."""
+    fleet = FleetMonitor()
+    fleet.observe("W0", {"clock": {"offset_s": 0.5, "rtt_s": 0.01}}, now=1.0)
+    assert fleet.clock_offset("W0") == 0.5
+    assert fleet.clock_offset("W1") is None
+    assert fleet.relative_offset("W0", SCHEDULER) == 0.5
+    assert fleet.relative_offset(SCHEDULER, "W0") == -0.5
+    assert fleet.relative_offset("W0", "W1") is None  # W1 never synced
+    fleet.observe("W1", {"clock": {"offset_s": -0.25, "rtt_s": 0.02}}, now=1.0)
+    assert fleet.relative_offset("W0", "W1") == 0.75
+    snap = fleet.snapshot(now=2.0)
+    assert snap["W0"]["clock_offset_ms"] == 500.0
+    assert snap["W1"]["clock_rtt_ms"] == 20.0
+
+
+def test_sync_clock_over_loopback_and_heartbeat_ingest():
+    """Manager.sync_clock min-RTT estimate: in-process both ends share one
+    monotonic clock, so the estimated offset must be ~0; the estimate then
+    rides the next heartbeat into the scheduler's FleetMonitor."""
+    van = MeteredVan(LoopbackVan())
+    try:
+        sched, managers, posts = launch_local_cluster(
+            van, num_workers=1, num_servers=1
+        )
+        fleet = FleetMonitor()
+        sched.fleet = fleet
+        mgr = managers[worker_id(0)]
+        off = mgr.sync_clock()
+        assert off is not None
+        assert abs(off) < 0.05  # single host, single clock
+        assert 0.0 <= mgr.clock_rtt < 0.05
+        assert mgr.wait(mgr.send_heartbeat(), timeout=30)
+        assert fleet.clock_offset(worker_id(0)) == off
+        assert fleet.relative_offset(worker_id(0), SCHEDULER) == off
+    finally:
+        van.close()

@@ -248,3 +248,35 @@ def test_reply_leg_has_no_stale_stamp_latency():
         assert reply["max_s"] >= 0.0
     finally:
         van.close()
+
+
+def test_clock_offset_corrects_deliver_latency():
+    """Cross-host deliver latency embeds sender clock skew; a registered
+    per-sender offset (sender monotonic minus local) is added back so the
+    histogram reads true one-way latency.  Simulated here by registering a
+    fake +250 ms skew on a zero-latency loopback link: the corrected
+    deliver readings must all land near +250 ms."""
+    van = MeteredVan(LoopbackVan())
+    try:
+        van.bind("B", lambda m: None)
+        van.set_clock_offset("A", 0.25)
+        for _ in range(5):
+            van.send(
+                Message(task=Task(TaskKind.CONTROL, "x"),
+                        sender="A", recver="B")
+            )
+        assert _settle(
+            lambda: van.links()["A->B"]["deliver"]["count"] == 5
+        )
+        d = van.links()["A->B"]["deliver"]
+        assert d["max_s"] >= 0.2  # raw ~0 + 0.25 correction
+        # clearing the offset stops the correction for later frames
+        van.set_clock_offset("A", 0.0)
+        van.send(
+            Message(task=Task(TaskKind.CONTROL, "x"), sender="A", recver="B")
+        )
+        assert _settle(
+            lambda: van.links()["A->B"]["deliver"]["count"] == 6
+        )
+    finally:
+        van.close()

@@ -1,0 +1,503 @@
+"""Same-id crash-restart recovery (incarnation fencing, ISSUE 5 tentpole).
+
+The scenario promotion (``tests/test_chaos.py``) dodges: a server process
+dies and comes back UNDER ITS OWN node id.  The transport half is the
+incarnation fence in ``core/resender.py`` (zombie frames dropped, seq space
+reset); the state half is ``kv/replica.restart_same_id`` (shard restored
+from the live standby — zero loss — or the latest checkpoint — bounded
+rewind); the membership half is the scheduler bumping the incarnation on
+re-registration (``core/manager.py``).
+
+Acceptance (ISSUE 5): kill and restart the SAME server node id twice
+mid-run under seeded 5% drop; training completes with the exact fault-free
+trajectory (replica path), push-apply count equal to the clean run's (zero
+duplicate-apply), and zero stale-incarnation frames delivered.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from parameter_server_tpu.config import OptimizerConfig, TableConfig
+from parameter_server_tpu.core.chaos import ChaosVan
+from parameter_server_tpu.core.messages import (
+    INCARNATION_KEY,
+    Message,
+    Task,
+    TaskKind,
+)
+from parameter_server_tpu.core.postoffice import Customer, Postoffice
+from parameter_server_tpu.core.resender import (
+    CRC_KEY,
+    SEQ_KEY,
+    ReliableVan,
+    payload_crc32,
+)
+from parameter_server_tpu.core.van import LoopbackVan
+from parameter_server_tpu.data.synthetic import SyntheticCTR
+from parameter_server_tpu.kv import replica as replica_lib
+from parameter_server_tpu.kv.server import KVServer
+from parameter_server_tpu.kv.worker import KVWorker
+from parameter_server_tpu.models import linear
+
+pytestmark = pytest.mark.chaos
+
+ROWS = 1 << 10
+NUM_SERVERS = 2
+STEPS = 12
+
+
+def _table_cfgs():
+    return {
+        "w": TableConfig(
+            name="w", rows=ROWS, dim=1,
+            optimizer=OptimizerConfig(kind="adagrad", learning_rate=0.1),
+        )
+    }
+
+
+def _batches():
+    data = SyntheticCTR(key_space=4 * ROWS, nnz=8, batch_size=128, seed=3)
+    return [data.next_batch() for _ in range(STEPS)]
+
+
+def _train(worker, batches, on_step=None):
+    losses = []
+    for i, (keys, labels) in enumerate(batches):
+        w_pos = worker.pull_sync("w", keys, timeout=60)
+        g, _gb, loss = linear.grad_rows(jnp.asarray(w_pos), jnp.asarray(labels))
+        worker.push_sync("w", keys, np.asarray(g) / labels.shape[0], timeout=60)
+        losses.append(float(loss))
+        if on_step is not None:
+            on_step(i)
+    return losses
+
+
+def _clean_reference():
+    van = LoopbackVan()
+    try:
+        servers = [
+            KVServer(Postoffice(f"S{s}", van), _table_cfgs(), s, NUM_SERVERS)
+            for s in range(NUM_SERVERS)
+        ]
+        worker = KVWorker(Postoffice("W0", van), _table_cfgs(), NUM_SERVERS)
+        losses = _train(worker, _batches())
+        return losses, sum(s.pushes for s in servers)
+    finally:
+        van.close()
+
+
+def _reliable_stack(*, seed=0, timeout=0.05, max_retries=60, **chaos_kw):
+    chaos = ChaosVan(LoopbackVan(), seed=seed, **chaos_kw)
+    van = ReliableVan(
+        chaos, timeout=timeout, backoff=1.0, max_retries=max_retries,
+        seed=seed,
+    )
+    return van, chaos
+
+
+def _settle(predicate, deadline_s=5.0):
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.01)
+    return predicate()
+
+
+# ------------------------------------------------------- acceptance e2e
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_same_id_double_restart_under_drop_matches_clean_run(seed):
+    """ISSUE 5 acceptance: S0 is killed and restarted IN PLACE twice
+    mid-run under seeded 5% drop.  The shard restores from the sync
+    replica chain, so the trajectory is exactly the fault-free run's, the
+    total applied-push count equals the clean run's (exactly-once held
+    across both restarts), and no stale-incarnation frame was delivered
+    (fenced frames are counted, never handled)."""
+    ref_losses, ref_applied = _clean_reference()
+
+    van, chaos = _reliable_stack(seed=seed, timeout=0.1, drop=0.05)
+    try:
+        primaries, standbys = replica_lib.make_replicated_servers(
+            van, _table_cfgs(), NUM_SERVERS, sync=True
+        )
+        worker = KVWorker(Postoffice("W0", van), _table_cfgs(), NUM_SERVERS)
+        s0_instances = [primaries[0]]
+
+        def restart():
+            # crash: both of the process's endpoints vanish (server identity
+            # + its replica-forwarding client endpoint)
+            van.unbind("S0")
+            van.unbind("S0.fw")
+            # local incarnation authority (no Manager in this test): the
+            # restarted process gets a fresh epoch before it goes live
+            van.restart_node("S0")
+            new_s0, source = replica_lib.restart_same_id(
+                van, _table_cfgs(), 0, NUM_SERVERS, standby=standbys[0]
+            )
+            assert source == "replica"
+            s0_instances.append(new_s0)
+
+        def on_step(i):
+            if i in (STEPS // 3, 2 * STEPS // 3):
+                restart()
+
+        losses = _train(worker, _batches(), on_step=on_step)
+        assert len(s0_instances) == 3  # original + two restarts
+        np.testing.assert_allclose(losses, ref_losses, rtol=1e-7, atol=0)
+        applied = sum(s.pushes for s in s0_instances) + primaries[1].pushes
+        assert applied == ref_applied  # zero duplicate-apply, zero loss
+        assert van.incarnations.get("S0") == 2
+        assert van.flush(10)
+        assert van.gave_up == 0
+        assert chaos.injected_drops > 0  # the run was actually lossy
+    finally:
+        van.close()
+
+
+def test_same_id_restart_checkpoint_fallback_bounded_rewind(tmp_path):
+    """No standby: the restarted shard rewinds to the latest COMMITTED
+    checkpoint — and no further (restored rows equal the snapshot taken at
+    save time bit-for-bit).  Training still completes end to end, and the
+    dedup windows into the node were dropped (pre-crash frames may
+    re-apply inside the accepted rewind, so exact parity is NOT asserted
+    — boundedness and completion are)."""
+    root = str(tmp_path / "ckpt")
+    van, _chaos = _reliable_stack(seed=3, timeout=0.1, drop=0.02)
+    try:
+        servers = [
+            KVServer(Postoffice(f"S{s}", van), _table_cfgs(), s, NUM_SERVERS)
+            for s in range(NUM_SERVERS)
+        ]
+        worker = KVWorker(Postoffice("W0", van), _table_cfgs(), NUM_SERVERS)
+        batches = _batches()
+        at_save = {}
+        restarted = {}
+
+        def on_step(i):
+            if i == 3:
+                worker.save_model(root, step=i, timeout=60)
+                at_save["shard"] = servers[0].export_shard()
+            if i == 7:
+                van.unbind("S0")
+                van.restart_node("S0")
+                new_s0, source = replica_lib.restart_same_id(
+                    van, _table_cfgs(), 0, NUM_SERVERS, ckpt_root=root
+                )
+                assert source == "checkpoint"
+                restarted["server"] = new_s0
+                # bounded rewind: the restored rows are EXACTLY the step-3
+                # snapshot — nothing newer survived, nothing older leaked in
+                got = new_s0.export_shard()
+                np.testing.assert_array_equal(
+                    got["w"]["value"], at_save["shard"]["w"]["value"]
+                )
+                for k, v in at_save["shard"]["w"]["state"].items():
+                    np.testing.assert_array_equal(got["w"]["state"][k], v)
+
+        losses = _train(worker, batches, on_step=on_step)
+        assert len(losses) == STEPS  # the run completed through the rewind
+        assert "server" in restarted
+        assert van.flush(10)
+    finally:
+        van.close()
+
+
+def test_restore_selection_replica_then_checkpoint_then_cold(tmp_path):
+    """restart_same_id restore preference: live standby > latest committed
+    checkpoint > cold deterministic re-init."""
+    root = str(tmp_path / "ckpt")
+    van, _chaos = _reliable_stack(seed=0, timeout=0.1)
+    try:
+        cfgs = _table_cfgs()
+        server = KVServer(Postoffice("S0", van), cfgs, 0, 1)
+        standby = KVServer(Postoffice("R0", van), cfgs, 0, 1)
+        cold_state = server.export_shard()["w"]["value"].copy()
+
+        # give server, standby, and checkpoint three DISTINCT states
+        worker = KVWorker(Postoffice("W0", van), cfgs, 1)
+        keys = np.arange(16, dtype=np.int64)
+        worker.push_sync("w", keys, np.ones(16, np.float32), timeout=60)
+        server.save_checkpoint(root, step=1)
+        from parameter_server_tpu import checkpoint
+
+        checkpoint.finalize(root, 1, 1, {"w": cfgs["w"].rows})
+        ckpt_state = server.export_shard()["w"]["value"].copy()
+        worker.push_sync("w", keys, np.ones(16, np.float32), timeout=60)
+        standby.import_shard(server.export_shard())
+        replica_state = standby.export_shard()["w"]["value"].copy()
+        assert not np.array_equal(ckpt_state, replica_state)
+
+        van.unbind("S0")
+        s, source = replica_lib.restart_same_id(
+            van, cfgs, 0, 1, standby=standby, ckpt_root=root
+        )
+        assert source == "replica"
+        np.testing.assert_array_equal(
+            s.export_shard()["w"]["value"], replica_state
+        )
+
+        van.unbind("S0")
+        s, source = replica_lib.restart_same_id(van, cfgs, 0, 1, ckpt_root=root)
+        assert source == "checkpoint"
+        np.testing.assert_array_equal(
+            s.export_shard()["w"]["value"], ckpt_state
+        )
+
+        van.unbind("S0")
+        s, source = replica_lib.restart_same_id(van, cfgs, 0, 1)
+        assert source == "cold"
+        np.testing.assert_array_equal(
+            s.export_shard()["w"]["value"], cold_state  # deterministic seed
+        )
+    finally:
+        van.close()
+
+
+# ------------------------------------------------ incarnation fence units
+
+
+def test_zombie_stale_incarnation_frames_are_fenced():
+    """A frame stamped with a superseded incarnation is dropped without an
+    ACK or delivery: the zombie's resender would exhaust its budget into
+    the void, and the successor's state is never touched."""
+    van = ReliableVan(LoopbackVan(), timeout=30.0)
+    try:
+        seen = []
+
+        class Recorder(Customer):
+            def handle_request(self, msg):
+                seen.append(float(msg.values[0][0]))
+                return msg.reply()
+
+        Recorder("rec", Postoffice("S0", van))
+        client = Customer("rec", Postoffice("W0", van))
+        ts = client.submit(
+            [Message(task=Task(TaskKind.PUSH, "rec"), recver="S0",
+                     values=[np.array([1.0])])]
+        )
+        assert client.wait(ts, timeout=10)
+        assert seen == [1.0]
+
+        assert van.restart_node("W0") == 1  # W0's process was replaced
+
+        # hand-craft what the dead pre-restart process would emit: a frame
+        # carrying the OLD incarnation (0 == omitted) and a fresh seq, with
+        # a VALID CRC — only the incarnation fence can reject it
+        zombie = Message(
+            task=Task(TaskKind.PUSH, "rec"), sender="W0", recver="S0",
+            values=[np.array([666.0])],
+        )
+        zombie.task.payload = {
+            SEQ_KEY: 99, CRC_KEY: payload_crc32(zombie),
+        }
+        acks_before = van.acks_sent
+        van.inner.send(zombie)  # inject below the resender's stamping
+        assert _settle(lambda: van.rejected_stale == 1)
+        time.sleep(0.05)  # grace: the frame must not trickle through late
+        assert seen == [1.0]  # never delivered
+        assert van.acks_sent == acks_before  # and never acked
+
+        # the successor (new incarnation) still works, from seq 0
+        ts = client.submit(
+            [Message(task=Task(TaskKind.PUSH, "rec"), recver="S0",
+                     values=[np.array([2.0])])]
+        )
+        assert client.wait(ts, timeout=10)
+        assert seen == [1.0, 2.0]
+    finally:
+        van.close()
+
+
+def test_incarnation_advance_resets_windows_and_seq():
+    """After restart_node the node's links restart at seq 0 under the new
+    incarnation and receivers accept them — without the reset, the fresh
+    seq 0 would read as a duplicate of pre-restart traffic and be eaten."""
+    van = ReliableVan(LoopbackVan(), timeout=30.0)
+    try:
+        seen = []
+
+        class Recorder(Customer):
+            def handle_request(self, msg):
+                seen.append(msg.task.payload.get("n"))
+                return msg.reply()
+
+        Recorder("rec", Postoffice("S0", van))
+        client = Customer("rec", Postoffice("W0", van))
+        for n in range(3):  # burn seqs 0..2 (plus ack/reply traffic)
+            ts = client.submit(
+                [Message(task=Task(TaskKind.PUSH, "rec", payload={"n": n}),
+                         recver="S0")]
+            )
+            assert client.wait(ts, timeout=10)
+        assert seen == [0, 1, 2]
+        assert van.dup_suppressed == 0
+
+        van.restart_node("W0")
+        for n in range(3, 6):  # new process: seqs 0..2 AGAIN, new inc
+            ts = client.submit(
+                [Message(task=Task(TaskKind.PUSH, "rec", payload={"n": n}),
+                         recver="S0")]
+            )
+            assert client.wait(ts, timeout=10)
+        assert seen == [0, 1, 2, 3, 4, 5]  # nothing eaten as a duplicate
+        assert van.dup_suppressed == 0
+        assert van.rejected_stale == 0
+    finally:
+        van.close()
+
+
+def test_manager_reregistration_bumps_incarnation_and_broadcasts():
+    """The scheduler is the incarnation authority: a REGISTER for an id it
+    already knows bumps the row's incarnation, re-broadcasts the binding,
+    and every endpoint's transport learns the new epoch."""
+    from parameter_server_tpu.core.manager import Manager, launch_local_cluster
+
+    van, _chaos = _reliable_stack(seed=0, timeout=0.1)
+    try:
+        sched, managers, _posts = launch_local_cluster(
+            van, num_workers=1, num_servers=1, heartbeat_timeout=30
+        )
+        row = [n for n in sched.nodes() if n.node_id == "S0"][0]
+        assert row.incarnation == 0
+
+        # the S0 process dies and a replacement re-registers under the id
+        van.unbind("S0")
+        new_mgr = Manager(
+            Postoffice("S0", van), num_workers=1, num_servers=1
+        )
+        assert new_mgr.register_with_scheduler(timeout=10)
+
+        row = [n for n in sched.nodes() if n.node_id == "S0"][0]
+        assert row.incarnation == 1
+        assert row.alive
+        # range assignment survived the restart
+        assert (row.range_begin, row.range_end) == sched.server_range("S0")
+        # the broadcast reached the transport fence on every endpoint
+        # (shared van in-process): frames from S0 now stamp incarnation 1
+        assert _settle(lambda: van.incarnations.get("S0") == 1)
+        # and the restarted node learned the full table back
+        assert _settle(
+            lambda: len(new_mgr.nodes()) == len(sched.nodes())
+        )
+        # peers saw the rejoin row too
+        w_mgr = managers["W0"]
+        assert _settle(
+            lambda: any(
+                n.node_id == "S0" and n.incarnation == 1
+                for n in w_mgr.nodes()
+            )
+        )
+    finally:
+        van.close()
+
+
+def test_full_restart_lifecycle_with_scheduler(tmp_path):
+    """learner.elastic.restart_server: crash S0, restore from the standby,
+    re-register — the scheduler bumps the incarnation and the worker keeps
+    training against the same identity with zero loss."""
+    from parameter_server_tpu.core.manager import launch_local_cluster
+    from parameter_server_tpu.learner.elastic import restart_server
+
+    ref_losses, _ = _clean_reference()
+
+    van, _chaos = _reliable_stack(seed=4, timeout=0.1, drop=0.02)
+    try:
+        sched, _managers, posts = launch_local_cluster(
+            van, num_workers=1, num_servers=NUM_SERVERS, heartbeat_timeout=30
+        )
+        cfgs = _table_cfgs()
+        # each node id has ONE Postoffice (the cluster's); KVServer and the
+        # Manager are sibling customers on it — same layout as production
+        standbys = [
+            KVServer(Postoffice(f"R{s}", van), cfgs, s, NUM_SERVERS)
+            for s in range(NUM_SERVERS)
+        ]
+        for s in range(NUM_SERVERS):
+            KVServer(
+                posts[f"S{s}"], cfgs, s, NUM_SERVERS,
+                replica=f"R{s}", replica_sync=True,
+            )
+        worker = KVWorker(posts["W0"], cfgs, NUM_SERVERS)
+        restarted = {}
+
+        def on_step(i):
+            if i != STEPS // 2:
+                return
+            van.unbind("S0")
+            van.unbind("S0.fw")
+            server, source, mgr = restart_server(
+                van, cfgs, 0, NUM_SERVERS,
+                num_workers=1, standby=standbys[0], heartbeat_timeout=30,
+            )
+            assert source == "replica"
+            assert mgr is not None
+            restarted["server"] = server
+
+        losses = _train(worker, _batches(), on_step=on_step)
+        np.testing.assert_allclose(losses, ref_losses, rtol=1e-7, atol=0)
+        assert "server" in restarted
+        row = [n for n in sched.nodes() if n.node_id == "S0"][0]
+        assert row.incarnation == 1
+        assert van.incarnations.get("S0") == 1
+        assert van.flush(10)
+    finally:
+        van.close()
+
+
+# --------------------------------------------------------- remote cancel
+
+
+def test_remote_cancel_drops_queued_work_at_receiver():
+    """Customer.cancel(remote=True): the CANCEL control frame fences a
+    delayed request at the receiving Postoffice — the dead request is
+    dropped instead of executed (the reference ran abandoned work to
+    completion)."""
+    chaos = ChaosVan(LoopbackVan(), seed=0)
+    try:
+        ran = []
+
+        class Recorder(Customer):
+            def handle_request(self, msg):
+                ran.append(self.post.node_id)
+                return msg.reply()
+
+        from parameter_server_tpu.core.chaos import ChaosConfig
+
+        s0_post = Postoffice("S0", chaos)
+        s1_post = Postoffice("S1", chaos)
+        Recorder("rec", s0_post)
+        Recorder("rec", s1_post)
+        client = Customer("rec", Postoffice("W0", chaos))
+
+        # S1's request leg is slow; S0 answers immediately
+        chaos.set_link("W0", "S1", ChaosConfig(delay=0.4))
+        ts = client.submit(
+            [
+                Message(task=Task(TaskKind.PUSH, "rec"), recver="S0"),
+                Message(task=Task(TaskKind.PUSH, "rec"), recver="S1"),
+            ]
+        )
+        assert _settle(lambda: ran == ["S0"])  # S0 executed
+        # cancel overtakes the delayed leg (its frame rides the link with
+        # the heal-time config — delivered synchronously)
+        chaos.set_link("W0", "S1", ChaosConfig())
+        assert client.cancel(ts, "test deadline", remote=True)
+        assert _settle(lambda: s1_post.cancelled_drops == 1, 3.0)
+        time.sleep(0.2)  # grace past the delayed delivery
+        assert ran == ["S0"]  # S1 never executed the dead request
+        assert s0_post.cancelled_drops == 0  # answered legs aren't fenced
+
+        # the fence was consumed; fresh requests to S1 execute normally
+        ts = client.submit(
+            [Message(task=Task(TaskKind.PUSH, "rec"), recver="S1")]
+        )
+        assert client.wait(ts, timeout=10)
+        assert ran == ["S0", "S1"]
+    finally:
+        chaos.close()
