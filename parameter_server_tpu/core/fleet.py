@@ -171,6 +171,27 @@ class FleetMonitor:
                 h.merge(LatencyHistogram.from_dict(digest["deliver"]))
         return h
 
+    def inbound_totals(self) -> Dict[str, dict]:
+        """Cumulative inbound wire load per node: ``{node: {bytes, msgs}}``.
+
+        Summed over the latest per-link digests of every link INTO each
+        node — the load-ranking signal the PR-6 rebalancer consumes
+        (``learner/elastic.py::RebalancePolicy``).  Cumulative by design:
+        the policy differences successive calls to get rates, so one missed
+        heartbeat cannot fake a load drop.
+        """
+        with self._lock:
+            links = dict(self._links)
+        out: Dict[str, dict] = {}
+        for link, digest in links.items():
+            _, _, recver = link.partition("->")
+            if not recver:
+                continue
+            row = out.setdefault(recver, {"bytes": 0, "msgs": 0})
+            row["bytes"] += int(digest.get("bytes", 0))
+            row["msgs"] += int(digest.get("msgs", 0))
+        return out
+
     def snapshot(self, now: Optional[float] = None) -> Dict[str, dict]:
         """Per-node derived rows: beat cadence, rates, inbound latency."""
         now = time.monotonic() if now is None else now

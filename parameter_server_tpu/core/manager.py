@@ -43,6 +43,9 @@ REMOVE_NODE = "remove_node"
 HEARTBEAT = "heartbeat"
 BARRIER = "barrier"
 PING = "ping"
+#: PR-6 routing-table broadcast: the scheduler owns the authoritative
+#: epoch-versioned RoutingTable and pushes new generations to the fleet.
+ROUTING = "routing"
 
 
 @dataclasses.dataclass
@@ -127,6 +130,12 @@ class Manager(Customer):
         #: elasticity callbacks: fn(node_id) on death / (re)join.
         self.on_node_dead: List[Callable[[str], None]] = []
         self.on_node_added: List[Callable[[str], None]] = []
+        #: latest RoutingTable seen (scheduler: the authoritative copy set by
+        #: set_routing; others: the last ROUTING broadcast adopted).
+        self.routing = None
+        #: fn(RoutingTable) fired on every newly-adopted broadcast — wire a
+        #: worker's ``adopt_routing`` here for eager (non-fence) convergence.
+        self.on_routing: List[Callable] = []
         self._monitor_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         #: scheduler-side sink for heartbeat stats (attach a
@@ -210,7 +219,56 @@ class Manager(Customer):
             return self._on_barrier(msg)
         elif cmd == PING:
             return self._on_ping(msg)
+        elif cmd == ROUTING:
+            self._on_routing(msg)
         return msg.reply()
+
+    # -- routing-table broadcast (PR 6) --------------------------------------
+    def set_routing(self, routing) -> None:
+        """Scheduler: adopt ``routing`` as authoritative and broadcast it.
+
+        One CONTROL message per alive node; delivery is per-node atomic (a
+        node sees the old table or the new one, never a blend) and stragglers
+        self-heal off server fences, so no global barrier is needed.
+        """
+        assert self.role == NodeRole.SCHEDULER, "set_routing on non-scheduler"
+        self.routing = routing
+        with self._table_lock:
+            targets = [
+                n.node_id
+                for n in self._table.values()
+                if n.alive and n.node_id != self.post.node_id
+            ]
+        msgs = [
+            Message(
+                task=Task(
+                    TaskKind.CONTROL,
+                    self.name,
+                    payload={"cmd": ROUTING, "routing": routing.to_payload()},
+                ),
+                recver=t,
+            )
+            for t in targets
+        ]
+        if msgs:
+            self.submit(msgs)
+
+    def _on_routing(self, msg: Message) -> None:
+        from parameter_server_tpu.kv.routing import RoutingTable
+
+        routing = RoutingTable.from_payload(msg.task.payload["routing"])
+        # highest epoch wins — broadcasts can arrive out of order across
+        # migrations, and a stale one must not roll a node's view back
+        if self.routing is not None and routing.epoch <= self.routing.epoch:
+            return
+        self.routing = routing
+        for cb in self.on_routing:
+            try:
+                cb(routing)
+            except Exception:  # noqa: BLE001 — one bad sink must not block
+                logging.getLogger(__name__).exception(
+                    "on_routing callback failed on %s", self.post.node_id
+                )
 
     # -- clock sync (heartbeat-RTT/2 offset estimation) ----------------------
     def _on_ping(self, msg: Message) -> Message:

@@ -447,13 +447,21 @@ class ReliableVan(VanWrapper):
             return len(self._pending)
 
     def flush(self, timeout: float = 5.0) -> bool:
-        """Block until every send is acked (or gave up).  False on timeout."""
+        """Block until every send is acked (or gave up), then flush inner.
+
+        Delegates the REMAINING budget down the wrapper chain (the
+        ``VanWrapper`` flush contract — ``tools/check_wrappers.py``): an
+        inner van with its own buffers must get its chance to drain them.
+        False on timeout at either layer.
+        """
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             if self.inflight() == 0:
-                return True
+                break
             time.sleep(0.005)
-        return self.inflight() == 0
+        if self.inflight() != 0:
+            return False
+        return self.inner.flush(max(deadline - time.monotonic(), 0.0))
 
     def counters(self) -> dict:
         with self._lock:

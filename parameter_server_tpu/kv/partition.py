@@ -11,6 +11,7 @@ local trash row).
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Iterator
 
 import numpy as np
@@ -21,9 +22,15 @@ class RangePartition:
     rows: int
     num_servers: int
 
-    @property
+    @functools.cached_property
     def offsets(self) -> np.ndarray:
-        """``num_servers + 1`` row offsets; server s owns [off[s], off[s+1])."""
+        """``num_servers + 1`` row offsets; server s owns [off[s], off[s+1]).
+
+        Cached: ``slice_ids`` sits on the per-request routing hot path and
+        was rebuilding the cumsum every call.  ``cached_property`` stores
+        into the instance ``__dict__`` directly, so the dataclass stays
+        frozen (no ``__setattr__`` involved).
+        """
         base = self.rows // self.num_servers
         rem = self.rows % self.num_servers
         sizes = [base + (1 if s < rem else 0) for s in range(self.num_servers)]

@@ -32,6 +32,7 @@ from typing import Callable, Dict, Optional
 from parameter_server_tpu.config import TableConfig
 from parameter_server_tpu.core.postoffice import Postoffice
 from parameter_server_tpu.core.van import Van
+from parameter_server_tpu.kv.routing import RoutingTable
 from parameter_server_tpu.kv.server import KVServer
 
 
@@ -47,10 +48,14 @@ def make_replicated_servers(
     sync: bool = True,
     max_lag: int = 8,
     device_replies: bool = False,
+    routing: Optional[RoutingTable] = None,
 ) -> tuple[list[KVServer], list[KVServer]]:
     """Build ``num_servers`` primaries, each chained to a hot standby.
 
     Returns ``(primaries, standbys)``; standby ``i`` mirrors shard ``i``.
+    ``routing`` seeds a non-uniform ownership map on BOTH sides of every
+    chain (a standby must hold its primary's exact shard layout — migration
+    control ops are chain-forwarded, so the pair stays in lockstep).
     """
     standbys = [
         KVServer(
@@ -59,6 +64,7 @@ def make_replicated_servers(
             s,
             num_servers,
             device_replies=device_replies,
+            routing=routing,
         )
         for s in range(num_servers)
     ]
@@ -72,6 +78,7 @@ def make_replicated_servers(
             replica=replica_id(s),
             replica_sync=sync,
             max_replica_lag=max_lag,
+            routing=routing,
         )
         for s in range(num_servers)
     ]
@@ -126,6 +133,7 @@ def restart_same_id(
     device_replies: bool = False,
     replica_sync: bool = True,
     max_lag: int = 8,
+    routing: Optional[RoutingTable] = None,
 ) -> tuple[KVServer, str]:
     """Bring ``S{server_index}`` back under its OWN node id after a crash.
 
@@ -160,7 +168,9 @@ def restart_same_id(
     is passed, so protection continues after the restart.
     """
     primary_id = f"S{server_index}"
-    for nid in (primary_id, f"{primary_id}.fw"):
+    # .fw = replica-forwarding client, .mig = migration-streaming client —
+    # both are the dead process's endpoints and must not answer for it
+    for nid in (primary_id, f"{primary_id}.fw", f"{primary_id}.mig"):
         try:
             van.unbind(nid)
         except Exception:  # noqa: BLE001 — already unbound is the normal case
@@ -171,6 +181,11 @@ def restart_same_id(
     disconnect = getattr(van, "disconnect", None)
     if disconnect is not None:
         disconnect(primary_id)
+    if routing is None and standby is not None:
+        # a post-migration shard layout lives in the standby's routing; the
+        # restarted server must be built with the SAME map or the imported
+        # arrays would not fit its tables
+        routing = standby.routing
     server = KVServer(
         Postoffice(primary_id, van),
         table_cfgs,
@@ -180,6 +195,7 @@ def restart_same_id(
         replica=None if standby is None else standby.post.node_id,
         replica_sync=replica_sync,
         max_replica_lag=max_lag,
+        routing=routing,
     )
     if standby is not None:
         server.import_shard(standby.export_shard())
@@ -198,7 +214,7 @@ def restart_same_id(
     logging.getLogger(__name__).info(
         "restart_same_id: %s restored from %s", primary_id, source
     )
-    for nid in (primary_id, f"{primary_id}.fw"):
+    for nid in (primary_id, f"{primary_id}.fw", f"{primary_id}.mig"):
         reconnect = getattr(van, "reconnect", None)
         if reconnect is not None:
             reconnect(nid)

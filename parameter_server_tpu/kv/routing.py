@@ -1,0 +1,226 @@
+"""Epoch-versioned routing tables: which server owns which row range.
+
+PR-6 replaces the implicit uniform :class:`~parameter_server_tpu.kv.partition.
+RangePartition` (frozen at launch) with an explicit routing table that live
+migration can rewrite.  The reference treats dynamic key-range reassignment
+as a first-class primitive (Li et al. §4.3 — a recovering/retiring server
+hands its range to peers); here the same idea runs over the incarnation /
+fencing substrate of PRs 1–4:
+
+- a :class:`RoutingTable` is an immutable value stamped with an **epoch**;
+  every :meth:`RoutingTable.move` returns a NEW table at ``epoch + 1``;
+- workers stamp the epoch onto every PUSH/PULL (``__repoch__``); a server
+  whose table disagrees answers with a typed ``__error__`` reply carrying
+  ``__fenced__`` and its own table (``__routing__``) — **rejected, not
+  lost**: the worker adopts the highest-epoch table it has seen and retries
+  exactly the rejected positions;
+- the scheduler (``core/manager.py``) owns the authoritative copy and
+  broadcasts it (ROUTING control verb), but fences are self-healing, so a
+  worker that missed the broadcast converges lazily off the rejects.
+
+Unlike ``RangePartition``, segments are arbitrary ``(offsets, owners)``
+splits: one server may own several disjoint ranges and a table's owners
+need not be ``0..n-1``.  Workers therefore ship **global** row ids on the
+wire and servers localize against their own shard map — local ids would be
+ambiguous the moment a range moves.
+"""
+
+from __future__ import annotations
+
+import bisect
+import dataclasses
+import functools
+from typing import Dict, Iterator, List, Tuple
+
+import numpy as np
+
+#: Task.payload key: routing epoch stamped by workers on every PUSH/PULL.
+ROUTING_EPOCH_KEY = "__repoch__"
+#: reply payload key: serialized RoutingTable riding a fence reject.
+ROUTING_KEY = "__routing__"
+#: reply payload key: marks a typed fence reject (wrong epoch / not owner).
+FENCED_KEY = "__fenced__"
+
+
+@dataclasses.dataclass(frozen=True)
+class TableRouting:
+    """One table's ownership map: ``owners[i]`` owns ``[offsets[i],
+    offsets[i+1])`` of the global row space ``[0, rows)``.
+
+    The trash row (global id == ``rows``, the PAD contract) is owned by the
+    LAST segment's owner — the same rule ``RangePartition`` used for the
+    last server, so uniform tables route identically to the legacy split.
+    """
+
+    rows: int
+    offsets: Tuple[int, ...]
+    owners: Tuple[int, ...]
+
+    def __post_init__(self) -> None:
+        off, own = self.offsets, self.owners
+        if len(off) != len(own) + 1:
+            raise ValueError(f"offsets/owners length mismatch: {off} / {own}")
+        if not own:
+            raise ValueError("a table needs at least one segment")
+        if off[0] != 0 or off[-1] != self.rows:
+            raise ValueError(f"offsets must span [0, {self.rows}): {off}")
+        if any(b <= a for a, b in zip(off, off[1:])):
+            raise ValueError(f"offsets must be strictly increasing: {off}")
+        if any(s < 0 for s in own):
+            raise ValueError(f"owners must be non-negative: {own}")
+
+    @functools.cached_property
+    def _off(self) -> np.ndarray:
+        return np.asarray(self.offsets, dtype=np.int64)
+
+    @classmethod
+    def uniform(cls, rows: int, num_servers: int) -> "TableRouting":
+        """The legacy even-contiguous split (RangePartition-compatible)."""
+        base, rem = divmod(rows, num_servers)
+        sizes = [base + (1 if s < rem else 0) for s in range(num_servers)]
+        # zero-row servers own no segment (tiny tables on big fleets)
+        offsets, owners = [0], []
+        for s, size in enumerate(sizes):
+            if size > 0:
+                owners.append(s)
+                offsets.append(offsets[-1] + size)
+        return cls(rows, tuple(offsets), tuple(owners))
+
+    # -- queries -------------------------------------------------------------
+    def distinct_owners(self) -> Tuple[int, ...]:
+        return tuple(sorted(set(self.owners)))
+
+    def owned_segments(self, server: int) -> List[Tuple[int, int]]:
+        """``[(lo, hi), ...]`` global ranges owned by ``server``, in order."""
+        return [
+            (int(self.offsets[i]), int(self.offsets[i + 1]))
+            for i, o in enumerate(self.owners)
+            if o == server
+        ]
+
+    def server_rows(self, server: int) -> int:
+        return sum(hi - lo for lo, hi in self.owned_segments(server))
+
+    def owner_of(self, row: int) -> int:
+        """Owner of global ``row``; the trash row (== rows) maps to the
+        last segment's owner."""
+        if row >= self.rows:
+            return self.owners[-1]
+        i = bisect.bisect_right(self.offsets, row) - 1
+        return self.owners[i]
+
+    # -- rewrites ------------------------------------------------------------
+    def move(self, lo: int, hi: int, to: int) -> "TableRouting":
+        """Reassign global rows ``[lo, hi)`` to server ``to``.
+
+        Splits segments at the boundaries, then coalesces adjacent segments
+        of the same owner, so the map stays canonical (two moves that land
+        on the same ownership compare equal).
+        """
+        if not (0 <= lo < hi <= self.rows):
+            raise ValueError(f"bad range [{lo}, {hi}) for rows={self.rows}")
+        bounds = sorted(set(self.offsets) | {lo, hi})
+        offsets, owners = [0], []
+        for a, b in zip(bounds, bounds[1:]):
+            o = to if lo <= a < hi else self.owner_of(a)
+            if owners and o == owners[-1]:
+                offsets[-1] = b  # coalesce with the previous segment
+            else:
+                owners.append(o)
+                offsets.append(b)
+        return TableRouting(self.rows, tuple(offsets), tuple(owners))
+
+
+@dataclasses.dataclass(frozen=True)
+class RoutingTable:
+    """Epoch-stamped ownership maps for every registered table.
+
+    Immutable: rewrites go through :meth:`move`, which bumps the epoch —
+    the monotonic epoch is what lets every node adopt "highest epoch wins"
+    without coordination (a fence reply carrying an OLDER table is simply
+    ignored; see ``KVWorker.adopt_routing``).
+    """
+
+    epoch: int
+    tables: Dict[str, TableRouting]
+
+    @classmethod
+    def uniform(cls, table_cfgs, num_servers: int, *, epoch: int = 0):
+        """Epoch-0 table matching the legacy RangePartition split.
+
+        ``table_cfgs``: ``{name: TableConfig}`` (anything with ``.rows``)
+        or ``{name: rows}``.
+        """
+        tables = {
+            t: TableRouting.uniform(int(getattr(cfg, "rows", cfg)), num_servers)
+            for t, cfg in table_cfgs.items()
+        }
+        return cls(epoch, tables)
+
+    def servers(self) -> Tuple[int, ...]:
+        """Sorted distinct owners across all tables."""
+        out: set = set()
+        for tr in self.tables.values():
+            out.update(tr.owners)
+        return tuple(sorted(out))
+
+    def move(self, table: str, lo: int, hi: int, to: int) -> "RoutingTable":
+        tables = dict(self.tables)
+        tables[table] = tables[table].move(lo, hi, to)
+        return RoutingTable(self.epoch + 1, tables)
+
+    # -- request slicing (the Parameter::Slice analogue) ---------------------
+    def slice_ids(
+        self, table: str, sorted_ids: np.ndarray
+    ) -> Iterator[Tuple[int, np.ndarray, np.ndarray]]:
+        """Split sorted global row ids by owning server.
+
+        Yields ``(server, positions, ids)`` for EVERY distinct owner of
+        ``table`` (empty included — BSP tasks expect a response per server):
+        ``positions`` indexes into ``sorted_ids`` (a server owning several
+        segments gets ONE merged message — ``Customer._on_response`` counts
+        at most one response per sender per ts), ``ids`` are the global rows
+        at those positions, still ascending.  Pad ids (== rows) ride with
+        the last segment's owner, as in the legacy split.
+        """
+        tr = self.tables[table]
+        n = sorted_ids.shape[0]
+        cut = np.searchsorted(sorted_ids, tr._off[1:-1], side="left")
+        bounds = np.concatenate([[0], cut, [n]])
+        per_owner: Dict[int, list] = {o: [] for o in tr.owners}
+        for i, o in enumerate(tr.owners):
+            a, b = int(bounds[i]), int(bounds[i + 1])
+            if b > a:
+                per_owner[o].append(np.arange(a, b, dtype=np.int64))
+        for o in sorted(per_owner):
+            segs = per_owner[o]
+            pos = (
+                np.concatenate(segs) if segs else np.empty(0, dtype=np.int64)
+            )
+            yield o, pos, sorted_ids[pos]
+
+    # -- wire form -----------------------------------------------------------
+    def to_payload(self) -> dict:
+        return {
+            "epoch": int(self.epoch),
+            "tables": {
+                t: {
+                    "rows": int(tr.rows),
+                    "offsets": [int(x) for x in tr.offsets],
+                    "owners": [int(x) for x in tr.owners],
+                }
+                for t, tr in self.tables.items()
+            },
+        }
+
+    @classmethod
+    def from_payload(cls, payload: dict) -> "RoutingTable":
+        tables = {
+            t: TableRouting(
+                int(blob["rows"]),
+                tuple(int(x) for x in blob["offsets"]),
+                tuple(int(x) for x in blob["owners"]),
+            )
+            for t, blob in payload["tables"].items()
+        }
+        return cls(int(payload["epoch"]), tables)

@@ -7,13 +7,30 @@ row-range shard of every registered table; requests arrive through the Van
 recv thread (one per node — the reference's single-Executor-thread model, so
 table mutation is single-threaded by construction) and the actual math runs
 as the KVTable's jit-compiled device steps.
+
+PR-6 ownership model: the shard is no longer the fixed uniform
+``RangePartition`` split — an epoch-versioned
+:class:`~parameter_server_tpu.kv.routing.RoutingTable` says which server
+owns which global row ranges, and **live migration** rewrites it at runtime:
+
+- Workers ship GLOBAL row ids stamped with their routing epoch
+  (``__repoch__``); a request whose epoch disagrees, or whose rows this
+  server does not own, is answered with a typed ``__error__`` reply carrying
+  ``__fenced__`` + this server's routing table — rejected, NOT lost (the
+  worker refreshes and retries; ``fenced_rejects`` counts these).
+- Migration control ops (``migrate_*``) stream a sub-range to a recipient
+  over the replica-chain transport path while the donor keeps serving;
+  the only freeze is the atomic commit handler (this recv thread), whose
+  duration is bounded by the final dirty-row delta.
 """
 
 from __future__ import annotations
 
 import collections
+import dataclasses
+import time
 import zlib
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -23,6 +40,12 @@ from parameter_server_tpu.config import TableConfig
 from parameter_server_tpu.core.messages import Message, Task, TaskKind
 from parameter_server_tpu.core.postoffice import Customer, Postoffice
 from parameter_server_tpu.kv.partition import RangePartition
+from parameter_server_tpu.kv.routing import (
+    FENCED_KEY,
+    ROUTING_EPOCH_KEY,
+    ROUTING_KEY,
+    RoutingTable,
+)
 from parameter_server_tpu.kv.table import KVTable
 from parameter_server_tpu.utils.keys import bucket_size
 from parameter_server_tpu.utils.trace import NULL_TRACER, Tracer
@@ -50,6 +73,8 @@ class KVServer(Customer):
         replica_sync: bool = False,
         max_replica_lag: int = 8,
         replica_ack_timeout: float = 60.0,
+        routing: Optional[RoutingTable] = None,
+        migrate_timeout: float = 30.0,
     ) -> None:
         """``replica``: node id of a hot-standby KVServer holding the same
         shard (chain replication of key ranges, the reference paper's §4.3
@@ -60,20 +85,33 @@ class KVServer(Customer):
         on primary death); ``False`` = async forwarding with at most
         ``max_replica_lag`` pushes in flight (bounded loss, no added push
         latency).  On death, :func:`parameter_server_tpu.kv.replica.promote`
-        rebinds the standby under the primary's node id."""
+        rebinds the standby under the primary's node id.
+
+        ``routing``: explicit ownership map; defaults to the uniform
+        epoch-0 split (identical to the legacy ``RangePartition``).  Pass a
+        post-migration table to spawn a server into an already-rebalanced
+        cluster (``scale_up`` spawns with ZERO owned rows and migrates onto
+        it)."""
         super().__init__(name, post)
         #: reply to pulls with device arrays instead of host numpy — the
         #: zero-copy mode for in-process (Loopback) planes where worker and
         #: server share the device; cross-host Vans keep numpy replies.
         self.device_replies = device_replies
         self.server_index = server_index
+        #: legacy uniform split — still the CHECKPOINT layout contract (shard
+        #: files are uniform-contiguous; see save_checkpoint's guard).
         self.partitions = {
             t: RangePartition(cfg.rows, num_servers) for t, cfg in table_cfgs.items()
+        }
+        self.table_cfgs = table_cfgs
+        self.routing = routing or RoutingTable.uniform(table_cfgs, num_servers)
+        self._shard_maps: Dict[str, tuple] = {
+            t: self._make_map(self.routing, t) for t in table_cfgs
         }
         self.tables: Dict[str, KVTable] = {
             t: KVTable(
                 cfg,
-                rows=self.partitions[t].server_rows(server_index),
+                rows=self.routing.tables[t].server_rows(server_index),
                 # stable across OS processes (builtin str hash is salted per
                 # interpreter — servers spawned as separate processes would
                 # init different rows than an in-process cluster, breaking
@@ -85,7 +123,20 @@ class KVServer(Customer):
         #: dashboard counters
         self.pushes = 0
         self.pulls = 0
+        self.fenced_rejects = 0
+        self.rows_migrated_in = 0
+        self.rows_migrated_out = 0
+        self.migration_freeze_s = 0.0
+        self.migration_freeze_last_s = 0.0
         self.tracer = tracer
+        self.migrate_timeout = migrate_timeout
+        #: in-flight donor migrations: mid -> {table, lo, hi, to, dirty}
+        self._migrations: Dict[str, dict] = {}
+        #: in-flight recipient staging: mid -> {table, lo, hi, chunks}
+        self._staging: Dict[str, dict] = {}
+        #: lazy side customer for donor->recipient streaming (own endpoint:
+        #: waiting for stage/install acks on this recv thread would deadlock)
+        self._mig: Optional[Customer] = None
         # -- hot-replica forwarding channel ---------------------------------
         self.replica = replica
         self.replica_sync = replica_sync
@@ -100,6 +151,74 @@ class KVServer(Customer):
             # routes the forwarded pushes into its normal kv handler.
             self._fwd_post = Postoffice(f"{post.node_id}.fw", post.van)
             self._fwd = Customer(name, self._fwd_post)
+
+    # -- routing / shard maps -------------------------------------------------
+    def _make_map(self, routing: RoutingTable, table: str) -> tuple:
+        """``(starts, ends, locals)`` of this server's owned segments.
+
+        Global row ``g`` in segment ``i`` lives at local row
+        ``g - starts[i] + locals[i]`` — segments pack contiguously into the
+        KVTable in global order.
+        """
+        segs = routing.tables[table].owned_segments(self.server_index)
+        starts = np.asarray([lo for lo, _ in segs], dtype=np.int64)
+        ends = np.asarray([hi for _, hi in segs], dtype=np.int64)
+        sizes = ends - starts
+        locs = np.concatenate([[0], np.cumsum(sizes)])[:-1].astype(np.int64)
+        return starts, ends, locs
+
+    def _try_localize(
+        self, table: str, gids: np.ndarray
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Map global rows to local rows against the CURRENT shard map.
+
+        Returns ``(local, owned)``: ``local[i]`` is valid iff ``owned[i]``.
+        """
+        starts, ends, locs = self._shard_maps[table]
+        gids = np.asarray(gids, dtype=np.int64)
+        if starts.size == 0:
+            return np.zeros(gids.shape, np.int64), np.zeros(gids.shape, bool)
+        idx = np.searchsorted(starts, gids, side="right") - 1
+        idx_c = np.clip(idx, 0, None)
+        owned = (idx >= 0) & (gids >= 0) & (gids < ends[idx_c])
+        local = np.where(owned, gids - starts[idx_c] + locs[idx_c], 0)
+        return local, owned
+
+    def _localize_request(self, table: str, keys) -> Optional[np.ndarray]:
+        """Worker keys (sorted GLOBAL ids, pad == global rows) -> local ids.
+
+        Pads map to this shard's trash row; returns None when any real id is
+        not owned here (the fence trigger).
+        """
+        grows = self.routing.tables[table].rows
+        kn = np.asarray(keys, dtype=np.int64)
+        out = np.full(kn.shape, self.tables[table].rows, dtype=np.int32)
+        real = kn < grows
+        if real.any():
+            local, owned = self._try_localize(table, kn[real])
+            if not owned.all():
+                return None
+            out[real] = local.astype(np.int32)
+        return out
+
+    def _fence_reply(self, msg: Message, why: str) -> Message:
+        """Typed reject: ``__error__`` + ``__fenced__`` + the CURRENT table.
+
+        The worker's retry loop keys on ``__fenced__`` (a real handler error
+        must still raise) and adopts the attached routing iff it is newer
+        than what it holds — rejected, not lost.
+        """
+        self.fenced_rejects += 1
+        reply = msg.reply()
+        reply.task = dataclasses.replace(
+            msg.task,
+            payload={
+                "__error__": why,
+                FENCED_KEY: True,
+                ROUTING_KEY: self.routing.to_payload(),
+            },
+        )
+        return reply
 
     def _forward_push(self, tname: str, msg: Message) -> None:
         fwd = Message(
@@ -137,11 +256,69 @@ class KVServer(Customer):
                 self._fwd.cancel(old, "replica flush deadline")
                 raise RuntimeError(f"replica flush: ts={old} not acked")
 
+    def _forward_control(self, payload: dict, keys=None, values=None) -> None:
+        """Replica-chain a migration control op, synchronously.
+
+        Rides the same per-link FIFO as forwarded pushes, so the standby
+        applies the shard-map change AFTER every push that preceded it here.
+        """
+        msg = Message(
+            task=Task(TaskKind.CONTROL, self._fwd.name, payload=payload),
+            recver=self.replica,
+            keys=keys,
+            values=values if values is not None else [],
+        )
+        ts = self._fwd.submit([msg], keep_responses=True)
+        if not self._fwd.wait(ts, timeout=self.replica_ack_timeout):
+            self._fwd.cancel(ts, "replica control deadline", remote=True)
+            self._fwd.take_responses(ts)
+            raise RuntimeError(
+                f"replica {self.replica} did not ack {payload.get('op')!r}"
+            )
+        errs = self._fwd.errors(ts)
+        self._fwd.take_responses(ts)
+        if errs:
+            raise RuntimeError(
+                f"replica {payload.get('op')!r} failed: " + "; ".join(errs)
+            )
+
+    def counters(self) -> dict:
+        """Migration/fence counters, Dashboard-mergeable (utils.metrics)."""
+        return {
+            "fenced_rejects": self.fenced_rejects,
+            "rows_migrated_in": self.rows_migrated_in,
+            "rows_migrated_out": self.rows_migrated_out,
+            "migration_freeze_s": round(self.migration_freeze_s, 6),
+        }
+
+    # -- request handling -----------------------------------------------------
     def handle_request(self, msg: Message) -> Message:
         if msg.task.kind == TaskKind.CONTROL:
             return self._handle_control(msg)
         tname = msg.task.payload["table"]
         table = self.tables[tname]
+        # Routing fence (PR-6): a stamped epoch that disagrees means the
+        # sender routed with a different table generation — reject with the
+        # current table rather than guessing (an id could alias a row this
+        # server owns under EITHER generation; applying would double-count
+        # when the worker retries the reject).  Unstamped requests (replica
+        # forwards, which follow the primary's apply order by construction)
+        # skip the epoch check but still ownership-check.
+        repoch = msg.task.payload.get(ROUTING_EPOCH_KEY)
+        if repoch is not None and repoch != self.routing.epoch:
+            return self._fence_reply(
+                msg,
+                f"routing epoch mismatch: request {repoch} != "
+                f"server {self.routing.epoch}",
+            )
+        ids_np = self._localize_request(tname, msg.keys)
+        if ids_np is None:
+            return self._fence_reply(
+                msg,
+                f"not owner: {self.post.node_id} does not own all of "
+                f"{len(np.asarray(msg.keys))} requested rows of {tname!r} "
+                f"at epoch {self.routing.epoch}",
+            )
         # cross-node stitching: echo the worker's trace context onto this
         # handler's spans so merge_traces can pair both ends of the request
         tctx = msg.task.payload.get("__trace__") or {}
@@ -155,10 +332,12 @@ class KVServer(Customer):
         # compiles a fresh device step, and the pallas kernels (block DMA)
         # reject unaligned id vectors outright.  Pads route to the trash row
         # with zero gradients (the established PAD contract).
-        n = int(np.asarray(msg.keys).shape[0])
+        n = int(ids_np.shape[0])
         b = _bucket(n)
-        ids_np = np.full(b, table.rows, dtype=np.int32)
-        ids_np[:n] = msg.keys
+        if b != n:
+            padded_ids = np.full(b, table.rows, dtype=np.int32)
+            padded_ids[:n] = ids_np
+            ids_np = padded_ids
         ids = jnp.asarray(ids_np)
         if msg.task.kind == TaskKind.PUSH:
             vals = msg.values[0]
@@ -175,6 +354,15 @@ class KVServer(Customer):
             with self.tracer.span("kv.server.push", **span_attrs):
                 table.push(ids, jnp.asarray(vals))
             self.pushes += 1
+            if self._migrations:
+                # dirty tracking: rows in a migrating range changed after
+                # their chunk may have shipped — the commit delta re-sends
+                # them, bounding the freeze to exactly this set
+                kn = np.asarray(msg.keys, dtype=np.int64)
+                for m in self._migrations.values():
+                    if m["table"] == tname:
+                        hit = kn[(kn >= m["lo"]) & (kn < m["hi"])]
+                        m["dirty"].update(int(x) for x in hit)
             if self.replica is not None:
                 # forward AFTER the local apply, in apply order (this recv
                 # thread is the only writer), so the standby replays the
@@ -210,9 +398,10 @@ class KVServer(Customer):
     def import_shard(self, shard: Dict[str, dict]) -> None:
         """Adopt an :meth:`export_shard` snapshot wholesale.
 
-        Row ranges must match (same ``server_index``/``num_servers``); the
-        donated push buffers are simply replaced, so the next push jit-step
-        runs on the imported arrays.
+        Row ranges must match (same ``server_index`` and the same routing
+        generation — post-migration restarts pass ``routing=`` at
+        construction); the donated push buffers are simply replaced, so the
+        next push jit-step runs on the imported arrays.
         """
         for t, blob in shard.items():
             table = self.tables[t]
@@ -220,6 +409,356 @@ class KVServer(Customer):
             table.state = {
                 k: jnp.asarray(v) for k, v in blob["state"].items()
             }
+
+    def _export_rows(
+        self, table: str, gids: np.ndarray
+    ) -> Tuple[np.ndarray, Dict[str, np.ndarray]]:
+        """Snapshot value + optimizer-state rows at GLOBAL ids (owned)."""
+        tbl = self.tables[table]
+        local, owned = self._try_localize(table, gids)
+        if not owned.all():
+            raise ValueError(
+                f"export of un-owned rows of {table!r} on {self.post.node_id}"
+            )
+        value = np.asarray(tbl.value)[local]
+        state = {k: np.asarray(v)[local] for k, v in tbl.state.items()}
+        return value, state
+
+    def export_range(
+        self, table: str, lo: int, hi: int
+    ) -> Tuple[np.ndarray, Dict[str, np.ndarray]]:
+        """:meth:`export_shard` generalized to an arbitrary global range."""
+        return self._export_rows(table, np.arange(lo, hi, dtype=np.int64))
+
+    # -- live migration (PR-6) ------------------------------------------------
+    def _ensure_mig(self) -> Customer:
+        """Donor-side streaming customer on its own endpoint (deadlock-free:
+        stage/install acks are processed by the ``.mig`` recv thread while
+        this server's recv thread blocks inside the migration handler)."""
+        if self._mig is None:
+            mig_post = Postoffice(f"{self.post.node_id}.mig", self.post.van)
+            self._mig = Customer(self.name, mig_post)
+        return self._mig
+
+    def _mig_rpc(
+        self, recver: str, payload: dict, keys=None, values=None
+    ) -> Message:
+        mig = self._ensure_mig()
+        ts = mig.submit(
+            [
+                Message(
+                    task=Task(TaskKind.CONTROL, mig.name, payload=payload),
+                    recver=recver,
+                    keys=keys,
+                    values=values,
+                )
+            ],
+            keep_responses=True,
+        )
+        if not mig.wait(ts, timeout=self.migrate_timeout):
+            mig.cancel(ts, f"migration {payload.get('op')!r} deadline",
+                       remote=True)
+            mig.take_responses(ts)
+            raise TimeoutError(
+                f"{payload.get('op')!r} to {recver} timed out"
+            )
+        errs = mig.errors(ts)
+        responses = mig.take_responses(ts)
+        if errs:
+            raise RuntimeError(
+                f"{payload.get('op')!r} to {recver} failed: " + "; ".join(errs)
+            )
+        return responses[0]
+
+    def _install_routing(
+        self, new_routing: RoutingTable, extra: Optional[dict] = None
+    ) -> None:
+        """Adopt ``new_routing``, rebuilding any table whose segments change.
+
+        ``extra``: ``{table: (gids, value, state)}`` — source rows for
+        newly-adopted ranges (the migration payload).  Runs on the recv
+        thread, so it is atomic wrt pushes.
+        """
+        for t, tbl in self.tables.items():
+            new_segs = new_routing.tables[t].owned_segments(self.server_index)
+            old_segs = self.routing.tables[t].owned_segments(self.server_index)
+            ex = (extra or {}).get(t)
+            if new_segs == old_segs and ex is None:
+                continue
+            self._rebuild_table(t, new_segs, ex)
+        self.routing = new_routing
+        self._shard_maps = {
+            t: self._make_map(new_routing, t) for t in self.tables
+        }
+
+    def _rebuild_table(
+        self, t: str, new_segs: List[Tuple[int, int]], extra
+    ) -> None:
+        """Re-pack the shard for a new segment layout.
+
+        Every new-layout row must come from either the OLD shard (kept or
+        re-ordered rows) or ``extra`` (adopted rows) — anything uncovered is
+        a protocol error, never silently zero-initialized.
+        """
+        tbl = self.tables[t]
+        parts = [np.arange(lo, hi, dtype=np.int64) for lo, hi in new_segs]
+        gids = (
+            np.concatenate(parts) if parts else np.empty(0, dtype=np.int64)
+        )
+        n = int(gids.shape[0])
+        old_v = np.asarray(tbl.value)
+        old_s = {k: np.asarray(v) for k, v in tbl.state.items()}
+        value = np.empty((n + 1, tbl.dim), dtype=old_v.dtype)
+        state = {
+            k: np.empty((n + 1, tbl.dim), dtype=old_v.dtype) for k in old_s
+        }
+        # carry the trash row (re-zeroed every push anyway, but optimizer
+        # fills must survive)
+        value[n] = old_v[tbl.rows]
+        for k in state:
+            state[k][n] = old_s[k][tbl.rows]
+        local, covered = self._try_localize(t, gids)
+        if covered.any():
+            src = local[covered]
+            value[:n][covered] = old_v[src]
+            for k in state:
+                state[k][:n][covered] = old_s[k][src]
+        if extra is not None:
+            ids_e, v_e, s_e = extra
+            ids_e = np.asarray(ids_e, dtype=np.int64)
+            if ids_e.size:
+                pos = np.searchsorted(ids_e, gids)
+                pos_c = np.minimum(pos, ids_e.size - 1)
+                hit = ids_e[pos_c] == gids
+                src = pos_c[hit]
+                value[:n][hit] = v_e[src]
+                for k in state:
+                    state[k][:n][hit] = np.asarray(s_e[k])[src]
+                covered = covered | hit
+        if n and not covered.all():
+            missing = gids[~covered]
+            raise RuntimeError(
+                f"shard rebuild of {t!r} on {self.post.node_id}: "
+                f"{missing.size} rows uncovered (first: {missing[:4]})"
+            )
+        tbl.resize(value, state)
+
+    def adopt_routing(self, routing) -> bool:
+        """Adopt a broadcast routing table (non-participant servers).
+
+        Accepts a :class:`RoutingTable` or its payload dict.  Only newer
+        epochs apply, and this path must NOT change this server's owned
+        segments — content moves exclusively through the migrate ops.
+        """
+        if isinstance(routing, dict):
+            routing = RoutingTable.from_payload(routing)
+        if routing.epoch <= self.routing.epoch:
+            return False
+        for t in self.tables:
+            if (
+                routing.tables[t].owned_segments(self.server_index)
+                != self.routing.tables[t].owned_segments(self.server_index)
+            ):
+                raise ValueError(
+                    f"adopt_routing would change owned segments of {t!r} on "
+                    f"{self.post.node_id}; use the migration protocol"
+                )
+        self._install_routing(routing)
+        return True
+
+    def _handle_migrate(self, msg: Message) -> Message:
+        op = msg.task.payload["op"]
+        p = msg.task.payload
+        if op == "migrate_begin":
+            # donor: arm dirty tracking for [lo, hi).  Idempotent restart: a
+            # fresh mid for the same range supersedes any stale attempt.
+            mid, t, lo, hi = p["mid"], p["table"], int(p["lo"]), int(p["hi"])
+            _, owned = self._try_localize(t, np.arange(lo, hi, dtype=np.int64))
+            if not owned.all():
+                raise ValueError(
+                    f"migrate_begin: {self.post.node_id} does not own "
+                    f"[{lo}, {hi}) of {t!r}"
+                )
+            stale = [
+                k
+                for k, m in self._migrations.items()
+                if (m["table"], m["lo"], m["hi"]) == (t, lo, hi)
+            ]
+            for k in stale:
+                del self._migrations[k]
+            self._migrations[mid] = {
+                "table": t, "lo": lo, "hi": hi, "dirty": set()
+            }
+            return msg.reply()
+        if op == "migrate_send":
+            # donor: stream one live chunk to the recipient, keep serving
+            # between chunks (requests queued behind this handler bound the
+            # per-chunk pause, not the whole transfer)
+            m = self._migrations[p["mid"]]
+            lo, hi = int(p["lo"]), int(p["hi"])
+            value, state = self.export_range(m["table"], lo, hi)
+            skeys = sorted(state)
+            self._mig_rpc(
+                p["to"],
+                {
+                    "op": "migrate_stage",
+                    "mid": p["mid"],
+                    "table": m["table"],
+                    "lo": lo,
+                    "hi": hi,
+                    "state_keys": skeys,
+                },
+                values=[value] + [state[k] for k in skeys],
+            )
+            return msg.reply()
+        if op == "migrate_stage":
+            # recipient: buffer a streamed chunk (host memory, not the table)
+            st = self._staging.setdefault(
+                p["mid"], {"table": p["table"], "chunks": []}
+            )
+            value = np.asarray(msg.values[0])
+            state = {
+                k: np.asarray(v)
+                for k, v in zip(p["state_keys"], msg.values[1:])
+            }
+            st["chunks"].append((int(p["lo"]), int(p["hi"]), value, state))
+            return msg.reply()
+        if op == "migrate_commit":
+            return self._commit_migration(msg)
+        if op == "migrate_install":
+            return self._install_migration(msg)
+        if op == "migrate_adopt":
+            # recipient's standby: adopt the fully-assembled range (chain-
+            # forwarded by the recipient inside its install, so it lands
+            # after every forwarded push that preceded the handoff)
+            routing = RoutingTable.from_payload(p["routing"])
+            gids = np.asarray(msg.keys, dtype=np.int64)
+            value = np.asarray(msg.values[0])
+            state = {
+                k: np.asarray(v)
+                for k, v in zip(p["state_keys"], msg.values[1:])
+            }
+            self._install_routing(
+                routing, extra={p["table"]: (gids, value, state)}
+            )
+            self.rows_migrated_in += int(gids.size)
+            return msg.reply()
+        if op == "migrate_release":
+            # donor's standby: drop the moved range, mirroring the primary
+            self._install_routing(RoutingTable.from_payload(p["routing"]))
+            return msg.reply()
+        if op == "migrate_abort":
+            self._migrations.pop(p["mid"], None)
+            self._staging.pop(p["mid"], None)
+            return msg.reply()
+        raise ValueError(f"unsupported migration op {op!r}")
+
+    def _commit_migration(self, msg: Message) -> Message:
+        """Donor commit = the freeze-fence window, bounded to the delta.
+
+        Runs entirely on the recv thread, so no push interleaves: export the
+        dirty delta, hand it to the recipient (which installs atomically),
+        then shrink the local shard and adopt the new epoch.  Requests queued
+        meanwhile hit the NEW table and fence — rejected, not lost.  Donor
+        crash before the install ack leaves the old routing everywhere:
+        the PR-4 restart path brings the donor back and the migration simply
+        re-runs (staged chunks are superseded by the new mid).
+        """
+        p = msg.task.payload
+        m = self._migrations.pop(p["mid"])
+        t0 = time.perf_counter()
+        new_routing = RoutingTable.from_payload(p["routing"])
+        t = m["table"]
+        dirty = np.asarray(sorted(m["dirty"]), dtype=np.int64)
+        d_value, d_state = self._export_rows(t, dirty)
+        skeys = sorted(d_state)
+        try:
+            self._mig_rpc(
+                p["to"],
+                {
+                    "op": "migrate_install",
+                    "mid": p["mid"],
+                    "table": t,
+                    "lo": m["lo"],
+                    "hi": m["hi"],
+                    "state_keys": skeys,
+                    "routing": new_routing.to_payload(),
+                },
+                keys=dirty,
+                values=[d_value] + [d_state[k] for k in skeys],
+            )
+        except Exception:
+            # install failed: the range is still owned (and served) here —
+            # re-arm tracking so the driver can retry/abort cleanly
+            self._migrations[p["mid"]] = m
+            raise
+        # recipient owns the range now: shrink + new epoch, atomically for
+        # every request behind this handler
+        self._install_routing(new_routing)
+        self.rows_migrated_out += m["hi"] - m["lo"]
+        if self.replica is not None:
+            self._forward_control(
+                {
+                    "op": "migrate_release",
+                    "table": t,
+                    "routing": new_routing.to_payload(),
+                }
+            )
+        freeze = time.perf_counter() - t0
+        self.migration_freeze_last_s = freeze
+        self.migration_freeze_s += freeze
+        return msg.reply(values=[np.asarray([freeze], np.float64)])
+
+    def _install_migration(self, msg: Message) -> Message:
+        """Recipient install: staged chunks + dirty delta -> grown shard."""
+        p = msg.task.payload
+        t, lo, hi = p["table"], int(p["lo"]), int(p["hi"])
+        st = self._staging.pop(p["mid"], {"chunks": []})
+        tbl = self.tables[t]
+        n = hi - lo
+        dtype = np.asarray(tbl.value).dtype
+        value = np.zeros((n, tbl.dim), dtype=dtype)
+        state_names = sorted(tbl.state)
+        state = {k: np.zeros((n, tbl.dim), dtype=dtype) for k in state_names}
+        covered = np.zeros(n, dtype=bool)
+        for c_lo, c_hi, c_val, c_state in st["chunks"]:
+            a, b = c_lo - lo, c_hi - lo
+            value[a:b] = c_val
+            for k in state_names:
+                state[k][a:b] = c_state[k]
+            covered[a:b] = True
+        d_ids = np.asarray(msg.keys, dtype=np.int64)
+        if d_ids.size:
+            d_val = np.asarray(msg.values[0])
+            d_state = dict(zip(p["state_keys"], msg.values[1:]))
+            idx = d_ids - lo
+            value[idx] = d_val
+            for k in state_names:
+                state[k][idx] = np.asarray(d_state[k])
+            covered[idx] = True
+        if not covered.all():
+            raise RuntimeError(
+                f"migrate_install of {t!r}[{lo}:{hi}) on {self.post.node_id}: "
+                f"{int((~covered).sum())} rows never staged"
+            )
+        routing = RoutingTable.from_payload(p["routing"])
+        gids = np.arange(lo, hi, dtype=np.int64)
+        self._install_routing(routing, extra={t: (gids, value, state)})
+        self.rows_migrated_in += n
+        if self.replica is not None:
+            self._forward_control(
+                {
+                    "op": "migrate_adopt",
+                    "table": t,
+                    "lo": lo,
+                    "hi": hi,
+                    "state_keys": state_names,
+                    "routing": routing.to_payload(),
+                },
+                keys=gids,
+                values=[value] + [state[k] for k in state_names],
+            )
+        return msg.reply()
 
     # -- checkpoint (reference SaveModel task: servers write their key-range
     # to file; src/app/linear_method/model_evaluation.h [U]) -----------------
@@ -231,14 +770,38 @@ class KVServer(Customer):
         if op == "load_model":
             self.restore_checkpoint(msg.task.payload["root"], msg.task.payload["step"])
             return msg.reply()
+        if op == "adopt_routing":
+            self.adopt_routing(msg.task.payload["routing"])
+            return msg.reply()
+        if op and op.startswith("migrate_"):
+            return self._handle_migrate(msg)
         raise ValueError(f"unsupported control op {op!r}")
 
     def save_checkpoint(self, root: str, step: int) -> None:
-        """Write this server's row-range of every table (value + opt state)."""
+        """Write this server's row-range of every table (value + opt state).
+
+        The shard-file format is uniform-contiguous (one ``row_offset`` per
+        shard); post-migration layouts (moved/split ranges) are refused with
+        a clear error — drain back to the uniform split before checkpointing,
+        or rely on replica-chain recovery (the README "Elastic rebalancing"
+        section documents this boundary).
+        """
         from parameter_server_tpu import checkpoint
 
         for t, table in self.tables.items():
             part = self.partitions[t]
+            uniform = [
+                (int(part.offsets[s]), int(part.offsets[s + 1]))
+                for s in (self.server_index,)
+            ]
+            segs = self.routing.tables[t].owned_segments(self.server_index)
+            if segs != [seg for seg in uniform if seg[1] > seg[0]]:
+                raise RuntimeError(
+                    f"save_checkpoint: {self.post.node_id} owns migrated "
+                    f"segments {segs} of {t!r} (uniform shard is {uniform}); "
+                    "the shard-file format is uniform-contiguous — drain the "
+                    "migration back or use replica-chain recovery"
+                )
             checkpoint.save_shard(
                 root,
                 step,

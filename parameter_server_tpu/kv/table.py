@@ -127,6 +127,26 @@ class KVTable:
             )
         self.value = jnp.asarray(value, dtype=self.value.dtype)
 
+    def resize(self, value: np.ndarray, state: Dict[str, np.ndarray]) -> None:
+        """Replace the shard wholesale with a DIFFERENT row count.
+
+        Live migration grows/shrinks a server's shard (``kv/server.py``
+        adopt/release); ``value``/``state`` arrive as ``[new_rows + 1, dim]``
+        host arrays INCLUDING the trash row.  The jitted push/pull steps are
+        shape-polymorphic (jax.jit retraces per shape), so no re-wiring is
+        needed — the next push simply compiles for the new shard size.
+        """
+        if value.ndim != 2 or value.shape[1] != self.dim or value.shape[0] < 1:
+            raise ValueError(f"bad resize value shape {value.shape}")
+        if set(state) != set(self.state):
+            raise ValueError(
+                f"optimizer state keys mismatch: {set(state)} != {set(self.state)}"
+            )
+        dtype = self.value.dtype
+        self.rows = int(value.shape[0]) - 1
+        self.value = jnp.asarray(value, dtype)
+        self.state = {k: jnp.asarray(v, dtype) for k, v in state.items()}
+
 
 @functools.partial(jax.jit, static_argnames=("num_rows",))
 def _combine_jit(inverse, values, num_rows: int):

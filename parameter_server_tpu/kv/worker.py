@@ -12,9 +12,18 @@ Pipeline per call (SURVEY.md §3.2 hot path, TPU mapping):
 2. device: ``segment_combine`` duplicate positions (push only) — the
    worker-side pre-reduction; under a mesh this is where the DP ``psum``
    lands (parallel/, later milestone).
-3. host: ``RangePartition.slice_ids`` — split the sorted slot segment per
-   server (the reference's ``Parameter::Slice``).
+3. host: ``RoutingTable.slice_ids`` — split the sorted slot segment per
+   OWNING server (the reference's ``Parameter::Slice``, but against the
+   epoch-versioned routing table of PR 6, so ranges can move at runtime).
 4. Van: one request per server; responses complete the timestamp.
+
+Routing fences (PR 6): every wire leg is stamped with the worker's routing
+epoch (``__repoch__``).  A server holding a different table generation
+answers with a typed ``__fenced__`` error carrying its own table; the
+``*_sync`` paths adopt the highest-epoch table seen and retry exactly the
+rejected positions — **rejected, not lost**.  Fire-and-forget ``push()``
+cannot observe replies, so during live migration use :meth:`push_sync`
+(which is what ``learner/elastic.py`` trains through).
 """
 
 from __future__ import annotations
@@ -22,7 +31,9 @@ from __future__ import annotations
 import contextlib
 import functools
 import itertools
-from typing import Dict, Optional, Tuple
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -32,6 +43,12 @@ from parameter_server_tpu.config import TableConfig
 from parameter_server_tpu.core.messages import Message, Task, TaskKind, server_id
 from parameter_server_tpu.core.postoffice import Customer, Postoffice
 from parameter_server_tpu.kv.partition import RangePartition
+from parameter_server_tpu.kv.routing import (
+    FENCED_KEY,
+    ROUTING_EPOCH_KEY,
+    ROUTING_KEY,
+    RoutingTable,
+)
 from parameter_server_tpu.ops import scatter
 from parameter_server_tpu.utils.keys import HashLocalizer, localize_to_slots
 from parameter_server_tpu.utils.trace import NULL_TRACER, Tracer
@@ -54,13 +71,21 @@ class KVWorker(Customer):
         min_bucket: int = 256,
         tracer: Tracer = NULL_TRACER,
         retry_on_timeout: bool = True,
+        routing: Optional[RoutingTable] = None,
+        max_fence_retries: int = 8,
+        fence_backoff: float = 0.02,
     ) -> None:
         """``retry_on_timeout``: when a pull's deadline expires (dead or
         mid-promotion server), cancel the stuck task and re-issue it ONCE
         against the same server identity — by then
         :class:`~parameter_server_tpu.kv.replica.ReplicaSet` has typically
         rebound ``S{i}`` to the promoted standby, so the retry lands on live
-        state and training continues without surfacing the death."""
+        state and training continues without surfacing the death.
+
+        ``routing``: initial routing table (defaults to the uniform epoch-0
+        split).  The worker converges to newer tables lazily off fence
+        rejects and eagerly off scheduler ROUTING broadcasts (wire either
+        into :meth:`adopt_routing`)."""
         super().__init__(name, post)
         #: host-side span recorder (Push/Pull latency histograms, SURVEY §5)
         self.tracer = tracer
@@ -68,6 +93,12 @@ class KVWorker(Customer):
         self.num_servers = num_servers
         self.min_bucket = min_bucket
         self.retry_on_timeout = retry_on_timeout
+        self.max_fence_retries = max_fence_retries
+        self.fence_backoff = fence_backoff
+        self.routing = routing or RoutingTable.uniform(table_cfgs, num_servers)
+        self._routing_lock = threading.Lock()
+        #: legacy uniform split, kept for introspection/compat — routing
+        #: decisions now go through ``self.routing``
         self.partitions = {
             t: RangePartition(cfg.rows, num_servers) for t, cfg in table_cfgs.items()
         }
@@ -79,8 +110,66 @@ class KVWorker(Customer):
         #: deadline-retry counters (surfaced next to transport counters)
         self.pull_retries = 0
         self.push_retries = 0
+        #: fence-driven routing refresh retries (the "rejected, not lost"
+        #: loop re-submitting fenced positions under the adopted table)
+        self.refresh_retries = 0
         #: cross-node trace ids (see :meth:`_trace_ctx`)
         self._trace_seq = itertools.count()
+
+    # -- routing --------------------------------------------------------------
+    def adopt_routing(self, routing) -> bool:
+        """Adopt a routing table iff it is NEWER than what this worker holds.
+
+        Accepts a :class:`RoutingTable` or its wire payload (the form riding
+        fence replies and scheduler broadcasts).  Highest epoch wins without
+        coordination: a fence carrying an older table — possible for a
+        bounded moment mid-broadcast — is simply ignored, and the backoff in
+        the retry loops outlasts the broadcast window.
+        """
+        if routing is None:
+            return False
+        if isinstance(routing, dict):
+            routing = RoutingTable.from_payload(routing)
+        with self._routing_lock:
+            if routing.epoch <= self.routing.epoch:
+                return False
+            self.routing = routing
+            return True
+
+    def counters(self) -> dict:
+        """Retry counters, Dashboard-mergeable (utils.metrics)."""
+        return {
+            "pull_retries": self.pull_retries,
+            "push_retries": self.push_retries,
+            "refresh_retries": self.refresh_retries,
+        }
+
+    @staticmethod
+    def _scan_fences(responses, order) -> Tuple[list, set, List[np.ndarray]]:
+        """Split a completed task's responses into (data, fenced senders,
+        fenced position arrays)."""
+        data, senders, fenced = [], set(), []
+        for resp in responses:
+            if resp.task.payload.get(FENCED_KEY):
+                senders.add(resp.sender)
+                fenced.append(order[resp.sender])
+            else:
+                data.append(resp)
+        return data, senders, fenced
+
+    @staticmethod
+    def _real_errors(errs, fenced_senders) -> list:
+        """Errors minus the typed fence rejects (recorded as 'S0: <err>')."""
+        return [
+            e
+            for e in errs
+            if not any(e.startswith(f"{s}: ") for s in fenced_senders)
+        ]
+
+    def _adopt_from(self, responses) -> None:
+        for resp in responses:
+            if resp.task.payload.get(FENCED_KEY):
+                self.adopt_routing(resp.task.payload.get(ROUTING_KEY))
 
     def _trace_ctx(self) -> dict:
         """Fresh trace context for one logical request.
@@ -99,46 +188,79 @@ class KVWorker(Customer):
         }
 
     # -- push ---------------------------------------------------------------
+    def _submit_push(
+        self,
+        table: str,
+        slots: np.ndarray,
+        combined,
+        positions: Optional[np.ndarray] = None,
+        *,
+        keep: bool = False,
+        tctx: Optional[dict] = None,
+    ) -> Tuple[int, Dict[str, np.ndarray]]:
+        """Wire one push of ``combined[positions]`` rows at global ids
+        ``slots[positions]``; returns ``(ts, {server: positions})``.
+
+        ``positions`` (absolute indices into ``slots``, ascending) defaults
+        to all of them; fence retries pass only the rejected subset.
+        """
+        tctx = tctx or self._trace_ctx()
+        routing = self.routing  # one consistent table per submit
+        if positions is None:
+            positions = np.arange(slots.shape[0], dtype=np.int64)
+        sub = slots[positions]
+        msgs, order = [], {}
+        for s, rel, ids in routing.slice_ids(table, sub):
+            abs_pos = positions[rel]
+            order[server_id(s)] = abs_pos
+            msgs.append(
+                Message(
+                    task=Task(
+                        TaskKind.PUSH,
+                        self.name,
+                        payload={
+                            "table": table,
+                            "__trace__": tctx,
+                            ROUTING_EPOCH_KEY: routing.epoch,
+                        },
+                    ),
+                    recver=server_id(s),
+                    keys=ids.astype(np.int32),
+                    values=[combined[abs_pos]],
+                )
+            )
+        # window: under a CoalescingVan the burst flushes at submit
+        # exit (no flush-timer latency); nested inside push_many's
+        # window it coalesces across tables instead
+        with self.coalesce_window():
+            return self.submit(msgs, keep_responses=keep), order
+
+    def _prepare_push(self, table: str, keys, values):
+        """Host half of a push: localize + device duplicate pre-combine."""
+        cfg = self.table_cfgs[table]
+        vals = np.asarray(values, dtype=cfg.dtype).reshape(keys.size, cfg.dim)
+        slots, inverse, _n = localize_to_slots(
+            keys, self.localizers[table], min_bucket=self.min_bucket
+        )
+        combined = np.asarray(
+            _segment_combine(jnp.asarray(inverse), jnp.asarray(vals), slots.shape[0])
+        )
+        return slots, combined
+
     def push(self, table: str, keys: np.ndarray, values: np.ndarray) -> int:
         """Push per-position gradient rows for ``keys``.  Returns timestamp.
 
         ``values`` has shape ``[len(keys), dim]`` (or ``[len(keys)]`` for
-        dim=1 tables).
+        dim=1 tables).  Fire-and-forget: cannot observe routing fences —
+        under live migration use :meth:`push_sync`.
         """
         tctx = self._trace_ctx()
         with self.tracer.span(
             "kv.push", table=table, n=int(keys.size), trace=tctx["tid"]
         ):
-            cfg = self.table_cfgs[table]
-            vals = np.asarray(values, dtype=cfg.dtype).reshape(keys.size, cfg.dim)
-            slots, inverse, _n = localize_to_slots(
-                keys, self.localizers[table], min_bucket=self.min_bucket
-            )
-            # device-side duplicate pre-combine (worker-side pre-reduction)
-            combined = np.asarray(
-                _segment_combine(
-                    jnp.asarray(inverse), jnp.asarray(vals), slots.shape[0]
-                )
-            )
-            msgs = []
-            for s, seg, local in self.partitions[table].slice_ids(slots):
-                msgs.append(
-                    Message(
-                        task=Task(
-                            TaskKind.PUSH,
-                            self.name,
-                            payload={"table": table, "__trace__": tctx},
-                        ),
-                        recver=server_id(s),
-                        keys=local,
-                        values=[combined[seg]],
-                    )
-                )
-            # window: under a CoalescingVan the burst flushes at submit
-            # exit (no flush-timer latency); nested inside push_many's
-            # window it coalesces across tables instead
-            with self.coalesce_window():
-                return self.submit(msgs)
+            slots, combined = self._prepare_push(table, keys, values)
+            ts, _ = self._submit_push(table, slots, combined, tctx=tctx)
+            return ts
 
     def push_device(self, table: str, keys: np.ndarray, values) -> int:
         """Device-resident push: gradient rows never leave the device.
@@ -150,8 +272,6 @@ class KVWorker(Customer):
         role of SURVEY §2 #19 in its TPU form.  (A cross-host Van serializes
         at its own boundary, which is where the reference copies too.)
         """
-        import jax.numpy as jnp  # local alias keeps the hot path explicit
-
         tctx = self._trace_ctx()
         with self.tracer.span(
             "kv.push", table=table, n=int(keys.size), trace=tctx["tid"]
@@ -161,25 +281,9 @@ class KVWorker(Customer):
             slots, inverse, _n = localize_to_slots(
                 keys, self.localizers[table], min_bucket=self.min_bucket
             )
-            combined = _segment_combine(
-                jnp.asarray(inverse), vals, slots.shape[0]
-            )
-            msgs = []
-            for s, seg, local in self.partitions[table].slice_ids(slots):
-                msgs.append(
-                    Message(
-                        task=Task(
-                            TaskKind.PUSH,
-                            self.name,
-                            payload={"table": table, "__trace__": tctx},
-                        ),
-                        recver=server_id(s),
-                        keys=local,
-                        values=[combined[seg]],
-                    )
-                )
-            with self.coalesce_window():
-                return self.submit(msgs)
+            combined = _segment_combine(jnp.asarray(inverse), vals, slots.shape[0])
+            ts, _ = self._submit_push(table, slots, combined, tctx=tctx)
+            return ts
 
     def coalesce_window(self):
         """Context manager batching this worker's sends per destination.
@@ -218,21 +322,32 @@ class KVWorker(Customer):
         )
         return self._submit_pull(table, slots, inverse, keys.shape)
 
-    def _submit_pull(self, table, slots, inverse, shape) -> int:
+    def _submit_pull(
+        self, table, slots, inverse, shape, positions: Optional[np.ndarray] = None
+    ) -> int:
         tctx = self._trace_ctx()
+        routing = self.routing
+        if positions is None:
+            positions = np.arange(slots.shape[0], dtype=np.int64)
+        sub = slots[positions]
         msgs = []
         order = {}
-        for s, seg, local in self.partitions[table].slice_ids(slots):
-            order[server_id(s)] = seg
+        for s, rel, ids in routing.slice_ids(table, sub):
+            abs_pos = positions[rel]
+            order[server_id(s)] = abs_pos
             msgs.append(
                 Message(
                     task=Task(
                         TaskKind.PULL,
                         self.name,
-                        payload={"table": table, "__trace__": tctx},
+                        payload={
+                            "table": table,
+                            "__trace__": tctx,
+                            ROUTING_EPOCH_KEY: routing.epoch,
+                        },
                     ),
                     recver=server_id(s),
-                    keys=local,
+                    keys=ids.astype(np.int32),
                 )
             )
         with self.coalesce_window():
@@ -243,7 +358,7 @@ class KVWorker(Customer):
             "n_slots": slots.shape[0],
             "shape": shape,
             "table": table,
-            # retained so a deadline retry can re-issue the identical pull
+            # retained so deadline/fence retries can re-issue subsets
             "slots": slots,
             "trace": tctx["tid"],
         }
@@ -253,7 +368,7 @@ class KVWorker(Customer):
         """Wait for pull ``ts``; on deadline, cancel the stuck task and
         retry ONCE against the (possibly promoted) server identity.
 
-        Returns ``(ts, plan, responses)`` with all kept state drained.
+        Returns ``(plan, responses, errs)`` with all kept state drained.
         """
         tid = self._pull_plans[ts].get("trace")
         with self.tracer.span("kv.pull.wait", ts=ts, trace=tid):
@@ -266,8 +381,13 @@ class KVWorker(Customer):
             self.cancel(ts, "pull deadline", remote=True)
             self.take_responses(ts)  # responses of the dead task: drained
             self.pull_retries += 1
+            pos = np.sort(np.concatenate(list(plan["order"].values())))
             ts = self._submit_pull(
-                plan["table"], plan["slots"], plan["inverse"], plan["shape"]
+                plan["table"],
+                plan["slots"],
+                plan["inverse"],
+                plan["shape"],
+                positions=pos,
             )
             tid = self._pull_plans[ts].get("trace")
             with self.tracer.span("kv.pull.wait", ts=ts, retry=1, trace=tid):
@@ -277,14 +397,47 @@ class KVWorker(Customer):
         responses = self.take_responses(ts)  # always drain kept state
         if not completed:
             raise TimeoutError(f"pull ts={ts} timed out")
-        if errs:  # a dropped leg must not read as zero weights
-            raise RuntimeError(f"pull ts={ts} failed on: " + "; ".join(errs))
-        if len(responses) < len(plan["order"]):
-            raise RuntimeError(
-                f"pull ts={ts} incomplete: {len(responses)}/"
-                f"{len(plan['order'])} servers answered (dead server?)"
+        return plan, responses, errs
+
+    def _pull_pairs(self, ts: int, timeout: Optional[float]) -> tuple:
+        """Resolve pull ``ts`` into ``(plan, [(positions, rows)])``, looping
+        over routing fences: fenced legs adopt the attached table and only
+        their positions are re-pulled (under the NEW epoch)."""
+        pairs: list = []
+        first_plan = None
+        for attempt in range(self.max_fence_retries + 1):
+            plan, responses, errs = self._await_pull(ts, timeout)
+            first_plan = first_plan or plan
+            self._adopt_from(responses)
+            data, fenced_senders, fenced = self._scan_fences(
+                responses, plan["order"]
             )
-        return ts, plan, responses
+            real = self._real_errors(errs, fenced_senders)
+            if real:  # a dropped leg must not read as zero weights
+                raise RuntimeError(f"pull ts={ts} failed on: " + "; ".join(real))
+            if len(responses) < len(plan["order"]):
+                raise RuntimeError(
+                    f"pull ts={ts} incomplete: {len(responses)}/"
+                    f"{len(plan['order'])} servers answered (dead server?)"
+                )
+            pairs.extend((plan["order"][r.sender], r.values[0]) for r in data)
+            if not fenced:
+                return first_plan, pairs
+            pos = np.sort(np.concatenate(fenced))
+            self.refresh_retries += 1
+            if attempt:  # mid-broadcast epoch bounce: outlast the window
+                time.sleep(self.fence_backoff * attempt)
+            ts = self._submit_pull(
+                first_plan["table"],
+                first_plan["slots"],
+                first_plan["inverse"],
+                first_plan["shape"],
+                positions=pos,
+            )
+        raise RuntimeError(
+            f"pull of {first_plan['table']!r}: routing fence retries "
+            f"exhausted after {self.max_fence_retries} refreshes"
+        )
 
     def pull_result(self, ts: int, timeout: Optional[float] = None) -> np.ndarray:
         """Block for pull ``ts`` and reassemble per-position weight rows.
@@ -292,12 +445,11 @@ class KVWorker(Customer):
         Output shape: ``keys.shape + (dim,)`` for dim>1 tables, ``keys.shape``
         for dim=1.
         """
-        ts, plan, responses = self._await_pull(ts, timeout)
+        plan, pairs = self._pull_pairs(ts, timeout)
         cfg = self.table_cfgs[plan["table"]]
         uniq_rows = np.zeros((plan["n_slots"], cfg.dim), dtype=cfg.dtype)
-        for resp in responses:
-            seg = plan["order"][resp.sender]
-            uniq_rows[seg] = resp.values[0]
+        for pos, rows in pairs:
+            uniq_rows[pos] = np.asarray(rows).reshape(-1, cfg.dim)
         out = uniq_rows[plan["inverse"]]
         if cfg.dim == 1:
             return out.reshape(plan["shape"])
@@ -311,16 +463,12 @@ class KVWorker(Customer):
         Returns a ``jax.Array`` of shape ``keys.shape + (dim,)`` (or
         ``keys.shape`` for dim=1).
         """
-        import jax
-        import jax.numpy as jnp
-
-        ts, plan, responses = self._await_pull(ts, timeout)
+        plan, pairs = self._pull_pairs(ts, timeout)
         cfg = self.table_cfgs[plan["table"]]
         uniq = jnp.zeros((plan["n_slots"], cfg.dim), jnp.dtype(cfg.dtype))
-        for resp in responses:
-            seg = plan["order"][resp.sender]
-            rows = jnp.asarray(resp.values[0]).reshape(-1, cfg.dim)
-            uniq = jax.lax.dynamic_update_slice(uniq, rows, (seg.start, 0))
+        for pos, rows in pairs:
+            rows = jnp.asarray(rows).reshape(-1, cfg.dim)
+            uniq = uniq.at[jnp.asarray(pos)].set(rows)
         out = jnp.take(uniq, jnp.asarray(plan["inverse"]), axis=0)
         if cfg.dim == 1:
             return out.reshape(plan["shape"])
@@ -338,7 +486,8 @@ class KVWorker(Customer):
         values: np.ndarray,
         timeout: Optional[float] = None,
     ) -> int:
-        """Push and block for all server acks, retrying once on deadline.
+        """Push and block for all server acks, retrying once on deadline and
+        looping on routing fences.
 
         The deadline path mirrors :meth:`pull_result`: the stuck task is
         cancelled (no leaked ``_pending`` state) and the push re-issued
@@ -350,24 +499,57 @@ class KVWorker(Customer):
         iff the original was applied but its ack was lost AND the transport
         below is unreliable.  Run over ``ReliableVan`` (acks retransmitted)
         that window closes: a surviving server acks, only a dead one
-        triggers the retry.  Returns the completing timestamp.
+        triggers the retry.
+
+        Fence loop (PR 6): legs rejected for a stale routing epoch or moved
+        range adopt the server's table and re-push ONLY the fenced positions
+        — the fence fired BEFORE any apply, so the retry cannot double-count
+        and the accepted legs are never re-sent.  Returns the completing
+        timestamp.
         """
-        ts = self.push(table, keys, values)
-        if self.wait(ts, timeout):
-            return ts
-        if not self.retry_on_timeout:
-            raise TimeoutError(f"push ts={ts} timed out")
-        # remote=True: servers that have not applied the original yet DROP
-        # it, closing the original+retry double-apply window that the
-        # docstring's transport argument alone cannot (a delayed request
-        # leg is not a retransmit, so ReliableVan dedup never sees it)
-        self.cancel(ts, "push deadline", remote=True)
-        self.push_retries += 1
-        ts = self.push(table, keys, values)
-        if not self.wait(ts, timeout):
-            self.cancel(ts, "push deadline (retry)")
-            raise TimeoutError(f"push ts={ts} timed out after retry")
-        return ts
+        slots, combined = self._prepare_push(table, keys, values)
+        positions: Optional[np.ndarray] = None
+        ts = -1
+        for attempt in range(self.max_fence_retries + 1):
+            ts, order = self._submit_push(
+                table, slots, combined, positions, keep=True
+            )
+            if not self.wait(ts, timeout):
+                if not self.retry_on_timeout:
+                    raise TimeoutError(f"push ts={ts} timed out")
+                # remote=True: servers that have not applied the original yet
+                # DROP it, closing the original+retry double-apply window
+                # that the transport argument alone cannot (a delayed request
+                # leg is not a retransmit, so ReliableVan dedup never sees it)
+                self.cancel(ts, "push deadline", remote=True)
+                self.take_responses(ts)
+                self.push_retries += 1
+                ts, order = self._submit_push(
+                    table, slots, combined, positions, keep=True
+                )
+                if not self.wait(ts, timeout):
+                    self.cancel(ts, "push deadline (retry)", remote=True)
+                    self.take_responses(ts)
+                    raise TimeoutError(f"push ts={ts} timed out after retry")
+            errs = self.errors(ts)
+            responses = self.take_responses(ts)
+            self._adopt_from(responses)
+            _, fenced_senders, fenced = self._scan_fences(responses, order)
+            real = self._real_errors(errs, fenced_senders)
+            if real:
+                raise RuntimeError(
+                    f"push ts={ts} failed on: " + "; ".join(real)
+                )
+            if not fenced:
+                return ts
+            positions = np.sort(np.concatenate(fenced))
+            self.refresh_retries += 1
+            if attempt:  # mid-broadcast epoch bounce: outlast the window
+                time.sleep(self.fence_backoff * attempt)
+        raise RuntimeError(
+            f"push of {table!r}: routing fence retries exhausted after "
+            f"{self.max_fence_retries} refreshes"
+        )
 
     # -- checkpoint (reference SaveModel/LoadModel broadcast tasks) ----------
     def save_model(
@@ -421,6 +603,8 @@ class KVWorker(Customer):
         self.take_responses(ts)
 
     def _broadcast_control(self, op: str, payload: dict) -> int:
+        # broadcast to the CURRENT owner set (post-migration it need not be
+        # the contiguous 0..num_servers-1 of the launch split)
         msgs = [
             Message(
                 task=Task(
@@ -428,7 +612,6 @@ class KVWorker(Customer):
                 ),
                 recver=server_id(s),
             )
-            for s in range(self.num_servers)
+            for s in self.routing.servers()
         ]
         return self.submit(msgs, keep_responses=True)
-

@@ -36,6 +36,7 @@ same code paths fire on a real DCN Van when a host drops.
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import threading
 import time
@@ -225,11 +226,16 @@ class ElasticTrainer:
                     g, _gb, loss = linear.grad_rows(
                         jnp.asarray(w_pos), jnp.asarray(labels)
                     )
-                    ts = kv.push(
-                        self.table, keys, np.asarray(g) / labels.shape[0]
+                    # push_sync, not fire-and-forget push: only the kept-
+                    # responses path can see a routing fence (PR 6), so this
+                    # is what lets a live migration reshard mid-training
+                    # without losing or double-applying a single push
+                    kv.push_sync(
+                        self.table,
+                        keys,
+                        np.asarray(g) / labels.shape[0],
+                        timeout=self.timeout,
                     )
-                    if not kv.wait(ts, timeout=self.timeout):
-                        raise TimeoutError(f"{wid} push never acked")
                     self.controller.finish_iteration(idx)
                     iteration += 1
                     with self._loss_lock:
@@ -299,6 +305,197 @@ def recover_server(
     server = make_server()
     server.restore_checkpoint(ckpt_root, step)
     return server
+
+
+@dataclasses.dataclass(frozen=True)
+class RebalanceConfig:
+    """Trigger thresholds for monitor-driven rebalancing.
+
+    Relative share with an absolute floor, like
+    :class:`~parameter_server_tpu.core.fleet.StragglerPolicy`: share-only
+    would fire on an idle fleet's noise, floor-only needs per-deployment
+    tuning.
+    """
+
+    #: a server is HOT when its share of the fleet's inbound bytes since the
+    #: previous check exceeds this (with >= 2 owners, uniform share is 1/n).
+    hot_share: float = 0.5
+    #: ignore observation windows with less total inbound traffic than this.
+    min_window_bytes: int = 1
+    #: fraction of the hot server's largest segment to move off (the tail
+    #: end — one split point, so the routing table grows by at most one
+    #: segment per move).
+    move_fraction: float = 0.5
+
+
+class RebalancePolicy:
+    """Closes the loop: FleetMonitor load ranking -> ShardMigrator moves.
+
+    Reads :meth:`~parameter_server_tpu.core.fleet.FleetMonitor.inbound_totals`
+    (cumulative inbound wire bytes per node, off the heartbeat link digests),
+    differences successive calls into a per-window load share, and when one
+    server's share crosses ``hot_share`` — or the monitor flags it as a
+    straggler — migrates the tail of its largest segment to the
+    least-loaded owner.  Drive it from the training loop or a monitor sweep:
+    ``routing, moved = policy.maybe_rebalance(routing)``.
+    """
+
+    def __init__(
+        self,
+        monitor,
+        migrator,
+        *,
+        config: Optional[RebalanceConfig] = None,
+        sched: Optional[Manager] = None,
+    ) -> None:
+        self.monitor = monitor
+        self.migrator = migrator
+        self.config = config or RebalanceConfig()
+        self.sched = sched
+        self._prev: Dict[str, int] = {}
+        #: move log: one dict per executed migration (dashboards/tests).
+        self.moves: List[dict] = []
+
+    def inbound_window(self, routing) -> Dict[int, int]:
+        """Inbound bytes per OWNING server since the previous call."""
+        from parameter_server_tpu.core.messages import server_id
+
+        totals = self.monitor.inbound_totals()
+        out: Dict[int, int] = {}
+        for s in routing.servers():
+            nid = server_id(s)
+            cur = int(totals.get(nid, {}).get("bytes", 0))
+            out[s] = cur - self._prev.get(nid, cur)
+            self._prev[nid] = cur
+        return out
+
+    def maybe_rebalance(self, routing, *, tables: Optional[List[str]] = None):
+        """One control-loop tick.  Returns ``(routing, moved)``.
+
+        At most one hot server is acted on per tick (the loop re-evaluates
+        with fresh load next tick — chasing several moves off one stale
+        window overshoots).
+        """
+        from parameter_server_tpu.core.messages import server_id
+
+        window = self.inbound_window(routing)
+        if len(window) < 2:
+            return routing, False
+        total = sum(max(v, 0) for v in window.values())
+        flagged = set(self.monitor.stragglers())
+        hot = max(window, key=lambda s: window[s])
+        share = window[hot] / total if total >= self.config.min_window_bytes else 0.0
+        if share < self.config.hot_share and server_id(hot) not in flagged:
+            return routing, False
+        cold = min(
+            (s for s in window if s != hot), key=lambda s: window[s]
+        )
+        moved = False
+        for t in tables or list(routing.tables):
+            segs = routing.tables[t].owned_segments(hot)
+            if not segs:
+                continue
+            lo, hi = max(segs, key=lambda ab: ab[1] - ab[0])
+            n = hi - lo
+            if n < 2:
+                continue  # nothing left to split off this server
+            cut = hi - max(1, int(n * self.config.move_fraction))
+            routing = self.migrator.migrate(
+                routing, t, cut, hi, cold, sched=self.sched
+            )
+            self.moves.append(
+                {
+                    "table": t,
+                    "lo": cut,
+                    "hi": hi,
+                    "frm": hot,
+                    "to": cold,
+                    "epoch": routing.epoch,
+                    "share": round(share, 4),
+                }
+            )
+            moved = True
+        return routing, moved
+
+
+def scale_up(
+    van,
+    table_cfgs,
+    routing,
+    new_index: int,
+    *,
+    migrator,
+    num_servers: Optional[int] = None,
+    device_replies: bool = False,
+    sched: Optional[Manager] = None,
+    moves: Optional[List[tuple]] = None,
+):
+    """Spawn ``S{new_index}`` and migrate ranges onto it, live.
+
+    The new server starts owning ZERO rows (present in the cluster, absent
+    from the routing table), so workers never see it until the first
+    migration commit flips the epoch — no global pause beyond each move's
+    bounded freeze window.  ``moves``: explicit ``[(table, lo, hi), ...]``;
+    default splits every table's largest segment in half and moves the tail.
+    Returns ``(server, routing)``.
+    """
+    from parameter_server_tpu.core.messages import server_id
+    from parameter_server_tpu.core.postoffice import Postoffice
+    from parameter_server_tpu.kv.server import KVServer
+
+    num_servers = num_servers if num_servers is not None else new_index + 1
+    server = KVServer(
+        Postoffice(server_id(new_index), van),
+        table_cfgs,
+        new_index,
+        num_servers,
+        device_replies=device_replies,
+        routing=routing,
+    )
+    if moves is None:
+        moves = []
+        for t, tr in routing.tables.items():
+            lo, hi = max(
+                (
+                    seg
+                    for s in routing.servers()
+                    for seg in tr.owned_segments(s)
+                ),
+                key=lambda ab: ab[1] - ab[0],
+            )
+            if hi - lo >= 2:
+                moves.append((t, (lo + hi) // 2, hi))
+    for t, lo, hi in moves:
+        routing = migrator.migrate(routing, t, lo, hi, new_index, sched=sched)
+    return server, routing
+
+
+def drain_down(
+    van,
+    routing,
+    server_index: int,
+    *,
+    migrator,
+    sched: Optional[Manager] = None,
+    plan: Optional[dict] = None,
+):
+    """Retire live server ``S{server_index}`` with zero loss.
+
+    Data plane first (:meth:`ShardMigrator.drain` migrates every owned range
+    off, each with its own bounded freeze), THEN the endpoints are unbound —
+    by the time the identity disappears the routing table references it
+    nowhere, so workers never time out against it.  Returns the new routing.
+    """
+    from parameter_server_tpu.core.messages import server_id
+
+    routing = migrator.drain(routing, server_index, sched=sched, plan=plan)
+    nid = server_id(server_index)
+    for endpoint in (nid, f"{nid}.fw", f"{nid}.mig"):
+        try:
+            van.unbind(endpoint)
+        except Exception:  # noqa: BLE001 — never-bound side endpoints
+            pass
+    return routing
 
 
 def restart_server(

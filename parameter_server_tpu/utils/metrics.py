@@ -67,6 +67,41 @@ def transport_counters(van) -> dict:
     return out
 
 
+class CounterGroup:
+    """Merge several ``counters()`` sources into one dict (summed keys).
+
+    The migration plane's counters live on MANY objects — each
+    :class:`~parameter_server_tpu.kv.server.KVServer` (``fenced_rejects``,
+    ``rows_migrated_in/out``, freeze seconds), each
+    :class:`~parameter_server_tpu.kv.worker.KVWorker` (``refresh_retries``,
+    deadline retries) and the
+    :class:`~parameter_server_tpu.kv.migrate.ShardMigrator` (moves/aborts).
+    Group them (``CounterGroup(*servers, *workers, migrator)``) and attach
+    as ``Dashboard(migration=...)`` so a rebalance shows up in the SAME rows
+    as retransmits and cancels.
+    """
+
+    def __init__(self, *sources) -> None:
+        self.sources = list(sources)
+
+    def add(self, *sources) -> "CounterGroup":
+        self.sources.extend(sources)
+        return self
+
+    def counters(self) -> dict:
+        out: dict = {}
+        for src in self.sources:
+            get = getattr(src, "counters", None)
+            if not callable(get):
+                continue
+            try:
+                for k, v in get().items():
+                    out[k] = out.get(k, 0) + v
+            except Exception:  # pragma: no cover — metrics must never crash
+                pass
+        return out
+
+
 def _auto_peak_flops() -> float:
     """Peak dense FLOP/s of the active backend for the MFU denominator.
 
@@ -179,6 +214,13 @@ class Dashboard:
     #: block counts and cumulative stall count/seconds (consumer time spent
     #: waiting on the producer; nonzero means ingest is the bottleneck).
     prefetch: Optional[object] = None
+    #: optional migration-plane counter source (anything with ``counters()``
+    #: — typically a :class:`CounterGroup` over servers/workers/migrator):
+    #: rows gain a ``migration`` dict — rows migrated in/out, fenced
+    #: (wrong-epoch) rejects, refresh retries, cumulative handoff freeze
+    #: seconds — so a live rebalance is visible in the same place as
+    #: retransmits and cancels.
+    migration: Optional[object] = None
     _start: float = dataclasses.field(default_factory=time.time)
     _last_obj: Optional[float] = None
     _last_t: Optional[float] = None
@@ -261,6 +303,13 @@ class Dashboard:
             if callable(pf_counters):
                 try:
                     row["prefetch"] = pf_counters()
+                except Exception:  # pragma: no cover — metrics must never
+                    pass  # crash training
+        if self.migration is not None:
+            mig_counters = getattr(self.migration, "counters", None)
+            if callable(mig_counters):
+                try:
+                    row["migration"] = mig_counters()
                 except Exception:  # pragma: no cover — metrics must never
                     pass  # crash training
         printing = self.print_every and iteration % self.print_every == 0

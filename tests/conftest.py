@@ -40,19 +40,21 @@ import pytest
 
 @pytest.hookimpl(hookwrapper=True)
 def pytest_runtest_makereport(item, call):
-    """On any failing ``chaos``-marked test, print the seed(s) involved.
+    """On any failing ``chaos``/``migration``-marked test, print the seeds.
 
     Seeded chaos runs are deterministic given (seed, send order), so a CI
     failure should be a one-liner to reproduce locally — but only if the
     seed makes it into the failure output.  Parametrized seeds come from
     ``item.callspec``; tests with hardcoded seeds can instead stash one via
-    ``item.user_properties.append(("chaos_seed", seed))``.
+    ``item.user_properties.append(("chaos_seed", seed))``.  Migration /
+    rebalance tests (PR 6) get the same one-line repro contract — their
+    kill-mid-stream and skew scenarios are seed-driven the same way.
     """
     outcome = yield
     report = outcome.get_result()
     if report.when != "call" or not report.failed:
         return
-    if "chaos" not in item.keywords:
+    if "chaos" not in item.keywords and "migration" not in item.keywords:
         return
     seeds = {}
     params = getattr(item, "callspec", None)

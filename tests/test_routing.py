@@ -1,0 +1,112 @@
+"""Unit tests for the epoch-versioned routing table (kv/routing.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from parameter_server_tpu.kv.partition import RangePartition
+from parameter_server_tpu.kv.routing import RoutingTable, TableRouting
+
+
+def test_uniform_matches_range_partition():
+    for rows, n in [(10, 3), (1024, 4), (7, 7), (5, 8)]:
+        tr = TableRouting.uniform(rows, n)
+        part = RangePartition(rows, n)
+        for s in range(n):
+            assert tr.server_rows(s) == part.server_rows(s)
+        # every row owned by the RangePartition server
+        off = part.offsets
+        for s in range(n):
+            for r in range(int(off[s]), int(off[s + 1])):
+                assert tr.owner_of(r) == s
+
+
+def test_trash_row_owned_by_last_segment_owner():
+    tr = TableRouting.uniform(10, 3)
+    assert tr.owner_of(10) == 2  # pad id == rows
+    moved = tr.move(7, 10, 0)
+    assert moved.owner_of(10) == 0
+
+
+def test_validation():
+    with pytest.raises(ValueError):
+        TableRouting(10, (0, 5), (0, 1))  # offsets don't span rows
+    with pytest.raises(ValueError):
+        TableRouting(10, (0, 5, 5, 10), (0, 1, 2))  # not strictly increasing
+    with pytest.raises(ValueError):
+        TableRouting(10, (0, 10), ())  # no segments
+
+
+def test_move_splits_and_coalesces():
+    tr = TableRouting.uniform(12, 3)  # [0,4)->0 [4,8)->1 [8,12)->2
+    m = tr.move(6, 8, 2)
+    assert m.owned_segments(1) == [(4, 6)]
+    assert m.owned_segments(2) == [(6, 12)]  # coalesced with [8,12)
+    # moving back restores the canonical original
+    back = m.move(6, 8, 1)
+    assert back.offsets == tr.offsets and back.owners == tr.owners
+    # idempotent move compares equal (canonical form)
+    assert m.move(6, 8, 2) == m
+
+
+def test_move_whole_range_leaves_single_owner():
+    tr = TableRouting.uniform(8, 2)
+    m = tr.move(0, 4, 1)
+    assert m.owned_segments(0) == []
+    assert m.owned_segments(1) == [(0, 8)]
+    assert m.distinct_owners() == (1,)
+
+
+def test_slice_ids_merges_multi_segment_owner():
+    # server 0 owns [0,4) and [8,12) — ONE message covering both segments
+    tr = TableRouting(12, (0, 4, 8, 12), (0, 1, 0))
+    rt = RoutingTable(epoch=3, tables={"w": tr})
+    ids = np.asarray([1, 3, 5, 9, 11], dtype=np.int64)
+    got = list(rt.slice_ids("w", ids))
+    assert [s for s, _, _ in got] == [0, 1]  # one entry per DISTINCT owner
+    pos0, ids0 = got[0][1], got[0][2]
+    np.testing.assert_array_equal(pos0, [0, 1, 3, 4])
+    np.testing.assert_array_equal(ids0, [1, 3, 9, 11])
+    np.testing.assert_array_equal(got[1][2], [5])
+
+
+def test_slice_ids_empty_legs_and_pads():
+    tr = TableRouting.uniform(12, 3)
+    rt = RoutingTable(epoch=0, tables={"w": tr})
+    # all ids + pads (== rows) land on server 2; others get EMPTY legs (BSP)
+    ids = np.asarray([9, 10, 12, 12], dtype=np.int64)
+    got = {s: ids_ for s, _, ids_ in rt.slice_ids("w", ids)}
+    assert set(got) == {0, 1, 2}
+    assert got[0].size == 0 and got[1].size == 0
+    np.testing.assert_array_equal(got[2], [9, 10, 12, 12])
+
+
+def test_slice_ids_covers_all_positions_exactly_once():
+    tr = TableRouting.uniform(100, 4).move(10, 30, 3).move(77, 80, 0)
+    rt = RoutingTable(epoch=2, tables={"w": tr})
+    ids = np.sort(np.random.RandomState(0).choice(100, 40, replace=False))
+    seen = np.concatenate([pos for _, pos, _ in rt.slice_ids("w", ids)])
+    np.testing.assert_array_equal(np.sort(seen), np.arange(40))
+    for s, pos, sids in rt.slice_ids("w", ids):
+        for g in sids:
+            assert tr.owner_of(int(g)) == s
+
+
+def test_routing_table_move_bumps_epoch_and_payload_roundtrip():
+    rt = RoutingTable.uniform({"w": 64, "b": 8}, 2)
+    assert rt.epoch == 0
+    rt2 = rt.move("w", 16, 32, 1)
+    assert rt2.epoch == 1
+    assert rt.tables["w"].owner_of(20) == 0  # original untouched
+    assert rt2.tables["w"].owner_of(20) == 1
+    rt3 = RoutingTable.from_payload(rt2.to_payload())
+    assert rt3.epoch == rt2.epoch
+    assert rt3.tables["w"] == rt2.tables["w"]
+    assert rt3.tables["b"] == rt2.tables["b"]
+
+
+def test_servers_lists_distinct_owners():
+    rt = RoutingTable.uniform({"w": 8}, 2).move("w", 0, 2, 5)
+    assert rt.servers() == (0, 1, 5)
+    assert rt.tables["w"].server_rows(5) == 2

@@ -1,0 +1,81 @@
+"""tools/check_wrappers.py wired as a tier-1 test (ISSUE 6 satellite).
+
+The Van wrapper flush/close-delegation and counters-no-recursion contracts
+were convention until PR 6; this keeps them enforced on every run.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import sys
+import textwrap
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+import check_wrappers  # noqa: E402
+
+
+def test_repo_wrappers_clean():
+    problems = []
+    for f in sorted((REPO / "parameter_server_tpu").rglob("*.py")):
+        if "VanWrapper" in f.read_text():
+            problems.extend(check_wrappers.check_file(f))
+    assert problems == [], "\n".join(problems)
+
+
+def test_catches_non_delegating_flush(tmp_path):
+    bad = tmp_path / "bad_van.py"
+    bad.write_text(
+        textwrap.dedent(
+            """
+            class SwallowingVan(VanWrapper):
+                def flush(self, timeout=5.0):
+                    return True  # drains nothing below
+
+                def close(self):
+                    self.inner.close()
+            """
+        )
+    )
+    problems = check_wrappers.check_file(bad)
+    assert len(problems) == 1
+    assert "SwallowingVan.flush" in problems[0]
+
+
+def test_catches_counters_recursion(tmp_path):
+    bad = tmp_path / "bad_counters.py"
+    bad.write_text(
+        textwrap.dedent(
+            """
+            class DoubleCountVan(VanWrapper):
+                def counters(self):
+                    return {**self.inner.counters(), "mine": 1}
+            """
+        )
+    )
+    problems = check_wrappers.check_file(bad)
+    assert len(problems) == 1
+    assert "DoubleCountVan.counters" in problems[0]
+
+
+def test_accepts_super_delegation(tmp_path):
+    ok = tmp_path / "ok_van.py"
+    ok.write_text(
+        textwrap.dedent(
+            """
+            class PoliteVan(VanWrapper):
+                def flush(self, timeout=5.0):
+                    self._drain_mine(timeout)
+                    return super().flush(timeout)
+
+                def close(self):
+                    self._thread.join()
+                    self.inner.close()
+
+                def counters(self):
+                    return {"mine": 1}
+            """
+        )
+    )
+    assert check_wrappers.check_file(ok) == []

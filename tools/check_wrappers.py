@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+"""Static contract check for VanWrapper subclasses.
+
+The Van decorator stack (``ReliableVan(ChaosVan(LoopbackVan()))`` +
+``CoalescingVan`` + ``MeteredVan``) relies on two conventions that, until
+PR 6, nothing enforced:
+
+1. **flush/close delegate down the chain.**  ``VanWrapper`` provides
+   delegating defaults, but a subclass that overrides either (to drain its
+   own buffers / join its own threads) MUST still call ``self.inner.flush``
+   / ``self.inner.close`` (or ``super()``'s) — otherwise a buffering layer
+   below it silently never drains, which reads as message loss only under
+   load.  This was a real latent bug: ``ReliableVan.flush`` drained its own
+   inflight table but swallowed the rest of the stack.
+
+2. **counters() does NOT recurse.**  ``utils.metrics.transport_counters``
+   walks the ``.inner`` chain itself and sums each layer's ``counters()``;
+   a layer that also merged its inner's counters would double-count every
+   key below it.
+
+Pure-AST check (no imports of the checked modules), so it runs in any
+environment and is wired as a tier-1 test (``tests/test_wrapper_contract.py``).
+Exit code 0 = clean; 1 = violations (one line each).
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import sys
+from typing import Iterator, List
+
+PKG = pathlib.Path(__file__).resolve().parent.parent / "parameter_server_tpu"
+
+#: methods that must delegate to the inner van when overridden.
+DELEGATING = ("flush", "close")
+
+
+def _base_names(cls: ast.ClassDef) -> List[str]:
+    out = []
+    for b in cls.bases:
+        if isinstance(b, ast.Name):
+            out.append(b.id)
+        elif isinstance(b, ast.Attribute):
+            out.append(b.attr)
+    return out
+
+
+def _calls(fn: ast.FunctionDef) -> Iterator[ast.Call]:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            yield node
+
+
+def _is_inner_call(call: ast.Call, method: str) -> bool:
+    """Matches ``self.inner.<method>(...)`` and ``super().<method>(...)``."""
+    f = call.func
+    if not (isinstance(f, ast.Attribute) and f.attr == method):
+        return False
+    v = f.value
+    if (
+        isinstance(v, ast.Attribute)
+        and v.attr == "inner"
+        and isinstance(v.value, ast.Name)
+        and v.value.id == "self"
+    ):
+        return True
+    if (
+        isinstance(v, ast.Call)
+        and isinstance(v.func, ast.Name)
+        and v.func.id == "super"
+    ):
+        return True
+    return False
+
+
+def _rel(path: pathlib.Path) -> str:
+    try:
+        return str(path.relative_to(PKG.parent))
+    except ValueError:  # checked file outside the repo (e.g. test fixtures)
+        return str(path)
+
+
+def check_file(path: pathlib.Path) -> List[str]:
+    tree = ast.parse(path.read_text(), filename=str(path))
+    problems: List[str] = []
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        if "VanWrapper" not in _base_names(cls):
+            continue
+        methods = {
+            n.name: n for n in cls.body if isinstance(n, ast.FunctionDef)
+        }
+        for name in DELEGATING:
+            fn = methods.get(name)
+            if fn is None:
+                continue  # inherits VanWrapper's delegating default — fine
+            if not any(_is_inner_call(c, name) for c in _calls(fn)):
+                problems.append(
+                    f"{_rel(path)}:{fn.lineno}: "
+                    f"{cls.name}.{name} overrides VanWrapper.{name} without "
+                    f"delegating to self.inner.{name} (or super().{name}) — "
+                    "layers below it never drain"
+                )
+        fn = methods.get("counters")
+        if fn is not None and any(
+            _is_inner_call(c, "counters") for c in _calls(fn)
+        ):
+            problems.append(
+                f"{_rel(path)}:{fn.lineno}: "
+                f"{cls.name}.counters merges self.inner.counters — "
+                "transport_counters walks the chain itself; this "
+                "double-counts every layer below"
+            )
+    return problems
+
+
+def main(argv: List[str]) -> int:
+    roots = [pathlib.Path(a) for a in argv[1:]] or [PKG]
+    problems: List[str] = []
+    found_wrapper = False
+    for root in roots:
+        files = [root] if root.is_file() else sorted(root.rglob("*.py"))
+        for f in files:
+            text = f.read_text()
+            if "VanWrapper" not in text:
+                continue
+            found_wrapper = True
+            problems.extend(check_file(f))
+    if not found_wrapper:
+        print("check_wrappers: no VanWrapper subclasses found", file=sys.stderr)
+        return 1  # a rename must fail loudly, not pass vacuously
+    for p in problems:
+        print(p)
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
