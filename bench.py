@@ -31,6 +31,7 @@ import json
 import os
 import subprocess
 import sys
+import tempfile
 import threading
 import time
 
@@ -179,6 +180,64 @@ def _probe_once(
     return True, out.strip()
 
 
+#: in-process probe memo: {"tpu"|"cpu": (ok, detail)} — one subprocess probe
+#: per backend per bench process, however many modes consult it.
+_PROBE_MEMO: dict = {}
+
+#: cross-process probe verdict marker.  A dead axon plugin costs
+#: retries x 75 s of wall per bench invocation (BENCH_r05 tail measured
+#: 3 x 75 s); repeated invocations in one session re-pay it every time.
+#: The marker caches the verdict for PS_BENCH_PROBE_CACHE_TTL_S (default
+#: 600 s) so only the first invocation pays.  ``PS_BENCH_PROBE_CACHE=0``
+#: disables both read and write (a flaky tunnel mid-recovery should not be
+#: pinned dead for 10 minutes).
+_PROBE_CACHE_PATH = os.path.join(
+    tempfile.gettempdir(), "ps_bench_probe_cache.json"
+)
+
+
+def _probe_cache_enabled() -> bool:
+    return os.environ.get("PS_BENCH_PROBE_CACHE", "1") != "0"
+
+
+def _probe_cache_get(kind: str) -> tuple[bool, str] | None:
+    if not _probe_cache_enabled():
+        return None
+    ttl = float(os.environ.get("PS_BENCH_PROBE_CACHE_TTL_S", 600.0))
+    try:
+        with open(_PROBE_CACHE_PATH, encoding="utf-8") as f:
+            cache = json.load(f)
+        entry = cache[kind]
+        ok, detail, stamp = bool(entry[0]), str(entry[1]), float(entry[2])
+    except (OSError, ValueError, KeyError, IndexError, TypeError):
+        return None
+    if time.time() - stamp > ttl:
+        return None
+    return ok, detail + " [cached verdict]"
+
+
+def _probe_cache_put(kind: str, ok: bool, detail: str) -> None:
+    if not _probe_cache_enabled():
+        return
+    try:
+        with open(_PROBE_CACHE_PATH, encoding="utf-8") as f:
+            cache = json.load(f)
+        if not isinstance(cache, dict):
+            cache = {}
+    except (OSError, ValueError):
+        cache = {}
+    cache[kind] = [ok, detail, time.time()]
+    try:
+        fd, tmp = tempfile.mkstemp(
+            dir=os.path.dirname(_PROBE_CACHE_PATH), suffix=".probe"
+        )
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            json.dump(cache, f)
+        os.replace(tmp, _PROBE_CACHE_PATH)  # atomic vs concurrent benches
+    except OSError:
+        pass  # cache is best-effort; the probe verdict itself stands
+
+
 def probe_backend(
     timeout_s: float | None = None, *, cpu: bool = False, retries: int | None = None
 ) -> tuple[bool, str]:
@@ -187,21 +246,35 @@ def probe_backend(
     ``PS_BENCH_PROBE_TIMEOUT_S`` (default 75) bounds each attempt;
     ``PS_BENCH_PROBE_RETRIES`` (default 2) re-probes a wedged plugin —
     transient tunnel hiccups recovered between both prior rounds' sessions.
+    The verdict is memoized in-process and cached across processes in a tmp
+    marker (see ``_PROBE_CACHE_PATH``), so a session's second bench run
+    skips a known-dead backend instead of re-paying 3 x 75 s of hang.
     """
+    kind = "cpu" if cpu else "tpu"
+    memo = _PROBE_MEMO.get(kind)
+    if memo is not None:
+        return memo
+    cached = _probe_cache_get(kind)
+    if cached is not None:
+        _PROBE_MEMO[kind] = cached
+        return cached
     if timeout_s is None:
         timeout_s = float(os.environ.get("PS_BENCH_PROBE_TIMEOUT_S", PROBE_TIMEOUT_S))
     if retries is None:
         retries = int(os.environ.get("PS_BENCH_PROBE_RETRIES", 2))
     detail = "no probe attempts"
+    ok = False
     for attempt in range(max(retries, 0) + 1):
         ok, detail = _probe_once(timeout_s, cpu=cpu)
         if ok:
-            return True, detail
+            break
         print(
             f"bench: probe attempt {attempt + 1}/{retries + 1} failed: {detail}",
             file=sys.stderr,
         )
-    return False, detail
+    _PROBE_MEMO[kind] = (ok, detail)
+    _probe_cache_put(kind, ok, detail)
+    return ok, detail
 
 
 def lr_flops_per_example(nnz: int) -> float:
@@ -1504,6 +1577,193 @@ def record_ingest(record: dict, lines: list[str]) -> None:
     )
 
 
+# -- Wire codec: flat frames vs pickle framing (ISSUE 7) -------------------
+
+_WIRE_BEGIN = "<!-- BENCH-WIRE:BEGIN -->"
+_WIRE_END = "<!-- BENCH-WIRE:END -->"
+
+#: per-shape timing repetitions (each shape is O(us)/frame; 2000 reps keeps
+#: the whole mode under a second while drowning timer noise)
+_WIRE_REPEATS = 2000
+
+
+def _wire_pickle_encode(msg) -> bytes:
+    """The pre-ISSUE-7 wire path, kept verbatim as the measurement baseline:
+    pickled header + raw planes (this exact code was core/tcp_van.py's
+    ``serialize_message`` until the flat-frame codec replaced it).  Lives in
+    bench.py only — the production hot path is pickle-free by contract
+    (tools/check_wrappers.py)."""
+    import pickle  # baseline measurement only; banned in core/{frame,tcp_van}
+    import struct as _struct
+
+    arrays = []
+    manifests = []
+    for a in ([msg.keys] if msg.keys is not None else []) + list(msg.values):
+        a = np.ascontiguousarray(a)
+        arrays.append(a)
+        manifests.append((str(a.dtype), a.shape))
+    header = pickle.dumps(
+        {
+            "task": (
+                msg.task.kind.value,
+                msg.task.customer,
+                msg.task.time,
+                msg.task.wait_time,
+                msg.task.payload,
+            ),
+            "sender": msg.sender,
+            "recver": msg.recver,
+            "is_request": msg.is_request,
+            "has_keys": msg.keys is not None,
+            "manifests": manifests,
+        },
+        protocol=pickle.HIGHEST_PROTOCOL,
+    )
+    parts = [_struct.pack("<I", len(header)), header]
+    parts += [memoryview(a).cast("B") for a in arrays]
+    return b"".join(parts)
+
+
+def _wire_pickle_crc(msg) -> int:
+    """The pre-ISSUE-7 end-to-end CRC: ``tobytes()`` copies per array."""
+    import zlib
+
+    crc = 0
+    if isinstance(msg.keys, np.ndarray):
+        crc = zlib.crc32(np.ascontiguousarray(msg.keys).tobytes(), crc)
+    for v in msg.values:
+        if isinstance(v, np.ndarray):
+            crc = zlib.crc32(np.ascontiguousarray(v).tobytes(), crc)
+    return crc & 0xFFFFFFFF
+
+
+def _wire_messages():
+    """Representative stamped traffic: what ReliableVan actually puts on the
+    wire during LR/DLRM training (resender seq/inc/crc stamps attached)."""
+    from parameter_server_tpu.core.messages import Message, Task, TaskKind
+
+    def stamped(extra=None):
+        p = {"table": "w", "__rseq__": 123457, "__rinc__": 2,
+             "__rcrc__": 0xDEADBEEF}
+        if extra:
+            p.update(extra)
+        return p
+
+    rng = np.random.default_rng(0)
+    push_small = Message(
+        task=Task(TaskKind.PUSH, "kv", payload=stamped()),
+        sender="W0", recver="S0", is_request=True,
+        keys=rng.integers(0, 1 << 20, 128).astype(np.uint64),
+        values=[rng.standard_normal((128, 8)).astype(np.float32)],
+    )
+    push_wide = Message(
+        task=Task(TaskKind.PUSH, "kv", payload=stamped()),
+        sender="W0", recver="S0", is_request=True,
+        keys=rng.integers(0, 1 << 20, 2048).astype(np.uint64),
+        values=[rng.standard_normal((2048, 32)).astype(np.float32)],
+    )
+    pull_req = Message(
+        task=Task(TaskKind.PULL, "kv", payload=stamped()),
+        sender="W0", recver="S0", is_request=True,
+        keys=rng.integers(0, 1 << 20, 1024).astype(np.uint64),
+        values=[],
+    )
+    ack = Message(
+        task=Task(TaskKind.CONTROL, "__resender__",
+                  payload={"__rack__": 123457, "__rinc__": 2}),
+        sender="S0", recver="W0", is_request=False,
+        keys=None, values=[],
+    )
+    return [
+        ("push_small", push_small),
+        ("push_wide", push_wide),
+        ("pull_req", pull_req),
+        ("ack", ack),
+    ]
+
+
+def run_wire() -> tuple[dict, list[str]]:
+    """Microbench the ISSUE 7 win: per-message overhead bytes and
+    serialize+CRC CPU time, flat frame codec vs the pickle framing it
+    replaced.  Both sides produce CRC-protected wire bytes: baseline =
+    pickle header + raw planes + tobytes() CRC pass; flat = core/frame.py
+    encode (header+meta+planes with the plane CRC computed inline over
+    memoryviews).  Host-only: no device, no probe."""
+    from parameter_server_tpu.core import frame
+
+    lines = []
+    shapes = {}
+    for name, msg in _wire_messages():
+        pick = _wire_pickle_encode(msg)
+        flat = frame.encode(msg)
+        info = frame.peek(flat)
+        planes = info.planes_len
+        pick_overhead = len(pick) - planes
+        reps = _WIRE_REPEATS
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            _wire_pickle_encode(msg)
+            _wire_pickle_crc(msg)
+        pick_us = (time.perf_counter() - t0) / reps * 1e6
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            frame.encode(msg)
+        flat_us = (time.perf_counter() - t0) / reps * 1e6
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            frame.decode(flat)
+        flat_dec_us = (time.perf_counter() - t0) / reps * 1e6
+        shapes[name] = {
+            "plane_bytes": int(planes),
+            "pickle_overhead_bytes": int(pick_overhead),
+            "flat_overhead_bytes": int(info.overhead),
+            "pickle_encode_crc_us": round(pick_us, 2),
+            "flat_encode_crc_us": round(flat_us, 2),
+            "flat_decode_us": round(flat_dec_us, 2),
+            "speedup": round(pick_us / flat_us, 2) if flat_us else None,
+        }
+        lines.append(
+            f"wire {name}: overhead {pick_overhead}B -> {info.overhead}B, "
+            f"serialize+crc {pick_us:.1f}us -> {flat_us:.1f}us "
+            f"({pick_us / flat_us:.2f}x), decode {flat_dec_us:.1f}us"
+        )
+    head = shapes["push_small"]
+    record = {
+        "metric": "wire_codec_serialize_crc_speedup_vs_pickle",
+        "value": head["speedup"],
+        "unit": "x",
+        "vs_baseline": None,
+        "shapes": shapes,
+    }
+    return record, lines
+
+
+def record_wire(record: dict, lines: list[str]) -> None:
+    stamp = time.strftime("%Y-%m-%d %H:%M:%S UTC", time.gmtime())
+    rows_md = "".join(
+        f"| {name} | {s['plane_bytes']:,} | {s['pickle_overhead_bytes']} | "
+        f"{s['flat_overhead_bytes']} | {s['pickle_encode_crc_us']} | "
+        f"{s['flat_encode_crc_us']} | {s['speedup']}x |\n"
+        for name, s in record["shapes"].items()
+    )
+    body = (
+        f"\n{stamp}; {_WIRE_REPEATS} reps/shape, host CPU only.\n\n"
+        "| message | plane B | pickle ovh B | flat ovh B | "
+        "pickle enc+crc us | flat enc+crc us | speedup |\n"
+        "|---|---|---|---|---|---|---|\n" + rows_md +
+        "\nBoth columns produce CRC-covered wire bytes; the flat codec "
+        "folds the plane CRC into the encode pass (zero tobytes() copies) "
+        "and carries resender stamps in the fixed 48-byte header.\n"
+    )
+    _splice_baseline(
+        _WIRE_BEGIN,
+        _WIRE_END,
+        body,
+        "## Wire codec: flat frames vs pickle framing "
+        "(auto-recorded by bench.py --wire)",
+    )
+
+
 # -- DLRM at scale: billion-row table proof (VERDICT r4 #3) ----------------
 
 _DLRM_SUBPROC_TIMEOUT_S = 1200.0
@@ -2692,6 +2952,29 @@ def _dispatch() -> None:
         _emit(record)
         print("\n".join(lines), file=sys.stderr)
         record_ingest(record, lines)
+        return
+    if "--wire" in sys.argv[1:]:
+        # host-side only: codec microbench, no TPU probe, no jax
+        _start_watchdog("wire_codec_serialize_crc_speedup_vs_pickle", "x")
+        try:
+            record, lines = run_wire()
+        except Exception as e:  # noqa: BLE001 — the JSON line must still emit
+            _emit(
+                {
+                    "metric": "wire_codec_serialize_crc_speedup_vs_pickle",
+                    "value": 0.0,
+                    "unit": "x",
+                    "vs_baseline": None,
+                    "error": f"wire failed: {type(e).__name__}: {e}"[:500],
+                }
+            )
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
+            return
+        _emit(record)
+        print("\n".join(lines), file=sys.stderr)
+        record_wire(record, lines)
         return
     if micro:
         _start_watchdog("micro_scatter_add_pallas_speedup_vs_xla", "x")

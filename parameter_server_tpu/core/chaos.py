@@ -64,6 +64,7 @@ from typing import Callable, Dict, Optional, Tuple
 
 import numpy as np
 
+from parameter_server_tpu.core import frame
 from parameter_server_tpu.core.messages import Message
 from parameter_server_tpu.core.van import Van, VanWrapper
 
@@ -311,15 +312,51 @@ class ChaosVan(VanWrapper):
 
     @staticmethod
     def _flip_bit(msg: Message, rng: random.Random) -> Optional[Message]:
-        """Return a shallow copy of ``msg`` with one payload bit flipped.
+        """Return a copy of ``msg`` with one in-flight payload bit flipped.
 
-        The flip lands in a COPY of one numpy array: the original message
-        object is a retransmit source held by the sender's ReliableVan, so
-        in-place mutation would poison every future retransmit and make
-        recovery impossible.  Device-resident (non-numpy) values are not
-        candidates — matching the CRC stamp's coverage in
-        ``core/resender.py``.  Returns None when nothing is corruptible.
+        The flip operates on the FLAT WIRE BUFFER: the message is encoded
+        into its ``core/frame.py`` frame, one bit of the key/value plane
+        region is flipped (a uniformly random plane byte — exactly what
+        wire corruption does to the bytes a TcpVan carries), and the frame
+        is decoded back with ``verify=False`` (a real receiver's header
+        plane-CRC would reject the frame at the transport; ChaosVan models
+        the residual case that slips past it, which the resender's
+        end-to-end ``__rcrc__`` stamp must still catch).  The original
+        message object is never touched: it is a retransmit source held by
+        the sender's ReliableVan, so in-place mutation would poison every
+        future retransmit and make recovery impossible.
+
+        Device-resident (non-numpy) values never ride a wire buffer in
+        this stack (they are delivered by reference), so such messages
+        fall back to the legacy direct array-copy flip — matching the CRC
+        stamp's type-based coverage in ``core/resender.py``.  Returns None
+        when nothing is corruptible.
         """
+        if (msg.keys is None or isinstance(msg.keys, np.ndarray)) and all(
+            isinstance(v, np.ndarray) for v in msg.values
+        ):
+            try:
+                data = frame.encode(msg)
+            except frame.FrameError:
+                data = None  # uncodable payload object: legacy flip below
+            if data is not None:
+                info = frame.peek(data)
+                if info.planes_len <= 0:
+                    return None  # no plane bytes — nothing corruptible
+                buf = bytearray(data)
+                off = (
+                    frame.HEADER_SIZE
+                    + info.meta_len
+                    + rng.randrange(info.planes_len)
+                )
+                buf[off] ^= 1 << rng.randrange(8)
+                out = frame.decode(bytes(buf), verify=False)
+                # decoded arrays are read-only frombuffer views; deliver
+                # owned writable copies like any chaos-free receive path
+                if out.keys is not None:
+                    out.keys = np.array(out.keys)
+                out.values = [np.array(v) for v in out.values]
+                return out
         candidates = []
         if isinstance(msg.keys, np.ndarray) and msg.keys.nbytes > 0:
             candidates.append(("keys", None))

@@ -5,8 +5,13 @@ into few large ranged messages; PR 1's :class:`ReliableVan` made every frame
 carry ACK/seq bookkeeping, so per-message overhead got *more* expensive.
 :class:`CoalescingVan` amortizes it: PUSH/PULL messages headed for the same
 link inside a flush window are merged into a single bundle frame — one
-pickle header, one seq/ACK leg, one filter pass (key-cache / zlib / int8
-quant see the concatenated arrays), one wire message.
+48-byte flat-frame header (``core/frame.py``), one seq/ACK leg, one filter
+pass (key-cache / zlib / int8 quant see the concatenated arrays), one wire
+message.  Bundling is re-encode-free by construction: member value arrays
+become planes of the ONE bundle frame (the codec joins their buffers
+directly), member key bytes concatenate into a single uint8 plane, and the
+only new bytes are one header plus a compact tuple index in the meta
+section.
 
 Stack position is OUTERMOST::
 
@@ -18,11 +23,12 @@ delivery of a bundle is exactly-once delivery of every sub-message, and the
 in-order unpack on the receive side preserves per-link FIFO within it.
 
 Wire format: a bundle is a CONTROL :class:`Task` for the reserved customer
-``__bundle__`` whose payload carries a per-sub-message index (customer,
-kind, time, payload, key dtype/shape, value count); ``Message.keys`` is the
-uint8 concatenation of every sub's key bytes (content-hashable by the
-key-caching filter) and ``Message.values`` is the flat concatenation of
-every sub's value arrays (quantized per-array by the int8 filter).
+``__bundle__`` whose payload carries a per-sub-message index of compact
+tuples ``(customer, kind, time, wait_time, payload, is_request, key_meta,
+n_values)``; ``Message.keys`` is the uint8 concatenation of every sub's key
+bytes (content-hashable by the key-caching filter) and ``Message.values``
+is the flat concatenation of every sub's value arrays (quantized per-array
+by the int8 filter).
 
 Both ends must be wrapped: an unwrapped receiver sees an unknown customer
 ``__bundle__`` and replies ``__error__`` (a loud config error, not silent
@@ -57,7 +63,12 @@ BUNDLE_KEY = "__subs__"
 
 
 def _pack(subs: list[Message]) -> Message:
-    """Merge ``subs`` (same sender/recver) into one bundle frame."""
+    """Merge ``subs`` (same sender/recver) into one bundle frame.
+
+    The index is a flat tuple per sub (positional, no repeated dict keys) —
+    it is the only per-sub overhead the bundle adds to the wire, so it is
+    kept as small as the meta codec allows.
+    """
     index = []
     key_chunks: list[np.ndarray] = []
     values: list = []
@@ -70,16 +81,16 @@ def _pack(subs: list[Message]) -> Message:
         else:
             key_meta = None
         index.append(
-            {
-                "customer": m.task.customer,
-                "kind": m.task.kind.value,
-                "time": m.task.time,
-                "wait_time": m.task.wait_time,
-                "payload": m.task.payload,
-                "is_request": m.is_request,
-                "keys": key_meta,
-                "n_values": len(m.values),
-            }
+            (
+                m.task.customer,
+                m.task.kind.value,
+                m.task.time,
+                m.task.wait_time,
+                m.task.payload,
+                m.is_request,
+                key_meta,
+                len(m.values),
+            )
         )
         values.extend(m.values)
     keys = (
@@ -108,9 +119,9 @@ def _unpack(msg: Message) -> list[Message]:
     subs: list[Message] = []
     k_off = 0
     v_off = 0
-    for sub in index:
-        if sub["keys"] is not None:
-            dtype, shape, nbytes = sub["keys"]
+    for customer, kind, time_, wait_time, payload, is_request, key_meta, n_v in index:
+        if key_meta is not None:
+            dtype, shape, nbytes = key_meta
             # .copy() gives an owned, aligned, writable buffer (frombuffer
             # views are read-only and the server mutates key arrays).
             keys = (
@@ -122,21 +133,20 @@ def _unpack(msg: Message) -> list[Message]:
             k_off += nbytes
         else:
             keys = None
-        n_v = sub["n_values"]
         subs.append(
             Message(
                 task=Task(
-                    kind=TaskKind(sub["kind"]),
-                    customer=sub["customer"],
-                    time=sub["time"],
-                    wait_time=sub["wait_time"],
-                    payload=sub["payload"],
+                    kind=TaskKind(kind),
+                    customer=customer,
+                    time=time_,
+                    wait_time=wait_time,
+                    payload=payload,
                 ),
                 sender=msg.sender,
                 recver=msg.recver,
                 keys=keys,
                 values=list(msg.values[v_off : v_off + n_v]),
-                is_request=sub["is_request"],
+                is_request=is_request,
             )
         )
         v_off += n_v

@@ -36,6 +36,7 @@ from typing import Callable, Dict, Optional, Tuple
 
 import numpy as np
 
+from parameter_server_tpu.core import frame
 from parameter_server_tpu.core.messages import Message
 from parameter_server_tpu.core.van import Van, VanWrapper
 from parameter_server_tpu.utils.trace import LatencyHistogram
@@ -67,11 +68,18 @@ def payload_nbytes(msg: Message) -> int:
 class _LinkStats:
     """Counters + histograms for one directed link."""
 
-    __slots__ = ("msgs", "bytes", "send", "deliver")
+    __slots__ = ("msgs", "bytes", "frame_bytes", "overhead_bytes",
+                 "send", "deliver")
 
     def __init__(self) -> None:
         self.msgs = 0
         self.bytes = 0
+        #: exact flat-frame wire size (``core/frame.py``): payload planes
+        #: PLUS the 48-byte fixed header and the encoded meta section —
+        #: per-message framing tax, measured rather than modeled.
+        self.frame_bytes = 0
+        #: the non-plane share of ``frame_bytes`` (header + meta).
+        self.overhead_bytes = 0
         self.send = LatencyHistogram()
         self.deliver = LatencyHistogram()
 
@@ -127,6 +135,16 @@ class MeteredVan(VanWrapper):
                     payload={**msg.task.payload, STAMP_KEY: time.monotonic()},
                 ),
             )
+        # exact wire framing for this message as sent (incl. the __mts__
+        # stamp just added): plane bytes + 48-byte header + meta section.
+        # ``frame_nbytes`` sizes the meta without building the frame and
+        # without touching device values; resender stamps added below ride
+        # the fixed header (lifted), so they contribute zero meta bytes and
+        # the per-layer accounting composes exactly.
+        try:
+            fbytes, obytes = frame.frame_nbytes(out)
+        except frame.FrameError:  # uncodable payload object (in-proc only)
+            fbytes, obytes = nbytes + frame.HEADER_SIZE, frame.HEADER_SIZE
         t0 = time.perf_counter()
         ok = self.inner.send(out)
         dt = time.perf_counter() - t0
@@ -134,6 +152,8 @@ class MeteredVan(VanWrapper):
             st = self._link(msg.sender, msg.recver)
             st.msgs += 1
             st.bytes += nbytes
+            st.frame_bytes += fbytes
+            st.overhead_bytes += obytes
             st.send.record(dt)
             if not ok:
                 self.undeliverable += 1
@@ -173,6 +193,12 @@ class MeteredVan(VanWrapper):
             return {
                 "wire_msgs": sum(st.msgs for st in self._links.values()),
                 "wire_bytes": sum(st.bytes for st in self._links.values()),
+                "wire_frame_bytes": sum(
+                    st.frame_bytes for st in self._links.values()
+                ),
+                "wire_overhead_bytes": sum(
+                    st.overhead_bytes for st in self._links.values()
+                ),
                 "wire_links": len(self._links),
                 "wire_undeliverable": self.undeliverable,
             }
@@ -184,6 +210,8 @@ class MeteredVan(VanWrapper):
                 f"{s}->{r}": {
                     "msgs": st.msgs,
                     "bytes": st.bytes,
+                    "frame_bytes": st.frame_bytes,
+                    "overhead_bytes": st.overhead_bytes,
                     "send": st.send.to_dict(),
                     "deliver": st.deliver.to_dict(),
                 }
@@ -203,6 +231,8 @@ class MeteredVan(VanWrapper):
                 f"{s}->{r}": {
                     "msgs": st.msgs,
                     "bytes": st.bytes,
+                    "frame_bytes": st.frame_bytes,
+                    "overhead_bytes": st.overhead_bytes,
                     "send": st.send.to_dict(),
                     "deliver": st.deliver.to_dict(),
                 }

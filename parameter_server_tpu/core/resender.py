@@ -73,6 +73,7 @@ from typing import Callable, Dict, Optional, Tuple
 
 import numpy as np
 
+from parameter_server_tpu.core.frame import plane_view
 from parameter_server_tpu.core.messages import (
     INCARNATION_KEY,
     IncarnationRegistry,
@@ -109,13 +110,21 @@ def payload_crc32(msg: Message) -> int:
     to corrupt) and hashing them would force the device sync that
     ``push_device`` exists to avoid.  The skip decision is type-based, so
     sender and receiver agree on what was covered.
+
+    Zero-copy: the CRC runs incrementally over each array's own buffer
+    (``core/frame.py``'s byte view) — no ``tobytes()`` materialization on
+    either the stamping or the verifying side.  ``ascontiguousarray`` is
+    a no-op passthrough for the contiguous arrays the wire always carries
+    and only copies genuinely strided inputs, where it is the cheapest way
+    to a hashable buffer anyway.  Byte-for-byte the same digest as the
+    old ``tobytes()`` form.
     """
     crc = 0
     if isinstance(msg.keys, np.ndarray):
-        crc = zlib.crc32(np.ascontiguousarray(msg.keys).tobytes(), crc)
+        crc = zlib.crc32(plane_view(np.ascontiguousarray(msg.keys)), crc)
     for v in msg.values:
         if isinstance(v, np.ndarray):
-            crc = zlib.crc32(np.ascontiguousarray(v).tobytes(), crc)
+            crc = zlib.crc32(plane_view(np.ascontiguousarray(v)), crc)
     return crc & 0xFFFFFFFF
 
 

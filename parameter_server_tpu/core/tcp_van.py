@@ -10,10 +10,14 @@ Design notes:
 
 - One ``TcpVan`` per *process*; multiple logical nodes (scheduler + servers +
   workers colocated on a host) may bind on it, exactly like LoopbackVan.
-- Wire format per frame: ``[u32 header_len][pickle header][raw arrays...]``
-  where the header carries Task fields + array dtype/shape manifests and the
-  arrays ride as raw bytes (the SArray zero-copy role: numpy views are taken
-  straight from the received buffer, no per-array pickling).
+- Wire format per frame: the flat self-describing layout of
+  ``core/frame.py`` — 48-byte fixed header (magic/version/kind/flags,
+  seq/incarnation/epoch stamps, plane CRC32, section lengths), a tag-encoded
+  binary meta section (NO pickle anywhere on this path), then the raw
+  contiguous key/value planes.  Arrays ride as raw bytes both ways (the
+  SArray zero-copy role: sends read array buffers directly, receives take
+  ``frombuffer`` views of the received buffer), and malformed or corrupted
+  frames are rejected with a typed ``FrameError`` off the header alone.
 - Filters (key caching / compression / quantization — core/filters.py) apply
   per link on the encoded Message before serialization, matching the
   reference's RemoteNode filter stacks.
@@ -25,16 +29,14 @@ from __future__ import annotations
 
 import ctypes
 import logging
-import pickle
 import socket
-import struct
 import threading
 from typing import Callable, Dict, Optional, Tuple
 
-import numpy as np
-
 from parameter_server_tpu import native
-from parameter_server_tpu.core.messages import Message, Task, TaskKind
+from parameter_server_tpu.core import frame
+from parameter_server_tpu.core.frame import FrameError
+from parameter_server_tpu.core.messages import Message
 from parameter_server_tpu.core.van import Van, _Endpoint
 
 _u8p = ctypes.POINTER(ctypes.c_uint8)
@@ -70,61 +72,18 @@ def _lib() -> ctypes.CDLL:
 
 
 def serialize_message(msg: Message) -> bytes:
-    """Message -> wire bytes.  Arrays ride raw after a pickled header."""
-    arrays = []
-    manifests = []
-    for a in ([msg.keys] if msg.keys is not None else []) + list(msg.values):
-        a = np.ascontiguousarray(a)
-        arrays.append(a)
-        manifests.append((str(a.dtype), a.shape))
-    header = pickle.dumps(
-        {
-            "task": (
-                msg.task.kind.value,
-                msg.task.customer,
-                msg.task.time,
-                msg.task.wait_time,
-                msg.task.payload,
-            ),
-            "sender": msg.sender,
-            "recver": msg.recver,
-            "is_request": msg.is_request,
-            "has_keys": msg.keys is not None,
-            "manifests": manifests,
-        },
-        protocol=pickle.HIGHEST_PROTOCOL,
-    )
-    # single copy: join reads the arrays' buffers directly (no tobytes()
-    # intermediates) — the SArray zero-copy role on the send side
-    parts = [struct.pack("<I", len(header)), header]
-    parts += [memoryview(a).cast("B") for a in arrays]
-    return b"".join(parts)
+    """Message -> flat frame bytes (``core/frame.py``).  One join over the
+    header, the binary meta section, and the arrays' own buffers — no
+    ``tobytes()`` intermediates, no pickle."""
+    return frame.encode(msg)
 
 
-def deserialize_message(buf: memoryview) -> Message:
-    (hlen,) = struct.unpack_from("<I", buf, 0)
-    head = pickle.loads(bytes(buf[4 : 4 + hlen]))
-    kind, customer, time_, wait_time, payload = head["task"]
-    off = 4 + hlen
-    arrays = []
-    for dtype, shape in head["manifests"]:
-        n = int(np.prod(shape)) if shape else 1
-        nbytes = n * np.dtype(dtype).itemsize
-        arr = np.frombuffer(buf, dtype=dtype, count=n, offset=off).reshape(shape)
-        arrays.append(arr)
-        off += nbytes
-    keys = arrays.pop(0) if head["has_keys"] else None
-    return Message(
-        task=Task(
-            kind=TaskKind(kind), customer=customer, time=time_,
-            wait_time=wait_time, payload=payload,
-        ),
-        sender=head["sender"],
-        recver=head["recver"],
-        keys=keys,
-        values=arrays,
-        is_request=head["is_request"],
-    )
+def deserialize_message(buf) -> Message:
+    """Flat frame bytes -> Message; arrays are zero-copy ``frombuffer``
+    views.  Raises :class:`~parameter_server_tpu.core.frame.FrameError`
+    (typed) on truncated/garbled/corrupt frames — including a plane CRC
+    check made in one pass over the raw buffer before any reconstruction."""
+    return frame.decode(buf)
 
 
 def _resolve(host: str) -> str:
@@ -181,6 +140,7 @@ class TcpVan(Van):
         self._closed = threading.Event()
         self.sent_messages = 0
         self.dropped_messages = 0
+        self.frame_rejects = 0
         self._dispatch = threading.Thread(
             target=self._dispatch_loop, name=f"tcpvan-dispatch-{self.port}",
             daemon=True,
@@ -356,8 +316,18 @@ class TcpVan(Van):
                 self._lib.ps_van_free(data)
             try:
                 msg = deserialize_message(memoryview(raw))
-            except Exception:
-                continue  # corrupt frame: drop (wire-level noise tolerance)
+            except FrameError as e:
+                # typed rejection off the header (bad magic/version, header or
+                # plane CRC mismatch, truncation): count it and keep the recv
+                # thread alive — wire noise reads as loss, repaired by the
+                # resender's retransmit, never as a dead transport
+                with self._lock:
+                    self.frame_rejects += 1
+                    self.dropped_messages += 1
+                logging.getLogger(__name__).debug(
+                    "tcpvan: rejecting %d-byte frame: %s", n, e
+                )
+                continue
             if msg.sender:
                 with self._lock:
                     self._peer_conns[msg.sender] = conn.value
@@ -390,6 +360,7 @@ class TcpVan(Van):
             return {
                 "sent": self.sent_messages,
                 "dropped": self.dropped_messages,
+                "frame_rejects": self.frame_rejects,
                 "bytes_sent": self.bytes_sent(),
                 "bytes_recv": self.bytes_recv(),
             }

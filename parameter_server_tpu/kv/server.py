@@ -341,18 +341,16 @@ class KVServer(Customer):
         ids = jnp.asarray(ids_np)
         if msg.task.kind == TaskKind.PUSH:
             vals = msg.values[0]
-            if isinstance(vals, jax.Array):  # device push: pad on device
-                if b != n:
-                    zeros = jnp.zeros((b - n,) + vals.shape[1:], vals.dtype)
-                    vals = jnp.concatenate([vals, zeros])
-            else:
-                vals = np.asarray(vals)
-                if b != n:
-                    padded = np.zeros((b,) + vals.shape[1:], dtype=vals.dtype)
-                    padded[:n] = vals
-                    vals = padded
+            if not isinstance(vals, jax.Array):
+                # direct device handoff: the wire value plane (a zero-copy
+                # frombuffer view of the received frame) feeds the device
+                # transfer as-is — no intermediate padded host copy
+                vals = jnp.asarray(np.asarray(vals))
+            if b != n:  # pad on device (exact zeros: bitwise-neutral)
+                zeros = jnp.zeros((b - n,) + vals.shape[1:], vals.dtype)
+                vals = jnp.concatenate([vals, zeros])
             with self.tracer.span("kv.server.push", **span_attrs):
-                table.push(ids, jnp.asarray(vals))
+                table.push(ids, vals)
             self.pushes += 1
             if self._migrations:
                 # dirty tracking: rows in a migrating range changed after

@@ -439,6 +439,24 @@ class KVWorker(Customer):
             f"exhausted after {self.max_fence_retries} refreshes"
         )
 
+    @staticmethod
+    def _sole_full_pair(pairs: list, n_slots: int):
+        """The single reply covering every slot in identity order, or None.
+
+        The common single-server (or single-owner-after-localize) pull has
+        exactly one ``(positions, rows)`` pair whose positions are
+        ``0..n_slots-1``; its rows array — a zero-copy view of the received
+        wire frame — can feed the inverse gather directly, skipping the
+        zeros allocation + scatter pass entirely.
+        """
+        if len(pairs) != 1:
+            return None
+        pos, rows = pairs[0]
+        pos = np.asarray(pos)
+        if pos.size == n_slots and np.array_equal(pos, np.arange(n_slots)):
+            return rows
+        return None
+
     def pull_result(self, ts: int, timeout: Optional[float] = None) -> np.ndarray:
         """Block for pull ``ts`` and reassemble per-position weight rows.
 
@@ -447,9 +465,15 @@ class KVWorker(Customer):
         """
         plan, pairs = self._pull_pairs(ts, timeout)
         cfg = self.table_cfgs[plan["table"]]
-        uniq_rows = np.zeros((plan["n_slots"], cfg.dim), dtype=cfg.dtype)
-        for pos, rows in pairs:
-            uniq_rows[pos] = np.asarray(rows).reshape(-1, cfg.dim)
+        sole = self._sole_full_pair(pairs, plan["n_slots"])
+        if sole is not None:
+            # dtype= is a no-op passthrough when the reply already matches
+            # (the normal case); only an off-dtype reply pays a cast copy
+            uniq_rows = np.asarray(sole, dtype=cfg.dtype).reshape(-1, cfg.dim)
+        else:
+            uniq_rows = np.zeros((plan["n_slots"], cfg.dim), dtype=cfg.dtype)
+            for pos, rows in pairs:
+                uniq_rows[pos] = np.asarray(rows).reshape(-1, cfg.dim)
         out = uniq_rows[plan["inverse"]]
         if cfg.dim == 1:
             return out.reshape(plan["shape"])
@@ -465,10 +489,14 @@ class KVWorker(Customer):
         """
         plan, pairs = self._pull_pairs(ts, timeout)
         cfg = self.table_cfgs[plan["table"]]
-        uniq = jnp.zeros((plan["n_slots"], cfg.dim), jnp.dtype(cfg.dtype))
-        for pos, rows in pairs:
-            rows = jnp.asarray(rows).reshape(-1, cfg.dim)
-            uniq = uniq.at[jnp.asarray(pos)].set(rows)
+        sole = self._sole_full_pair(pairs, plan["n_slots"])
+        if sole is not None:
+            uniq = jnp.asarray(sole, jnp.dtype(cfg.dtype)).reshape(-1, cfg.dim)
+        else:
+            uniq = jnp.zeros((plan["n_slots"], cfg.dim), jnp.dtype(cfg.dtype))
+            for pos, rows in pairs:
+                rows = jnp.asarray(rows).reshape(-1, cfg.dim)
+                uniq = uniq.at[jnp.asarray(pos)].set(rows)
         out = jnp.take(uniq, jnp.asarray(plan["inverse"]), axis=0)
         if cfg.dim == 1:
             return out.reshape(plan["shape"])

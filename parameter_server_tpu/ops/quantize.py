@@ -22,8 +22,17 @@ def quantize_int8(
     per_row: bool = False,
     stochastic: bool = False,
     rng: Optional[np.random.Generator] = None,
+    seed: Optional[int] = None,
 ) -> Tuple[np.ndarray, np.ndarray]:
-    """float array -> (int8 array, float32 scale).  scale shape: [] or [rows,1]."""
+    """float array -> (int8 array, float32 scale).  scale shape: [] or [rows,1].
+
+    Stochastic rounding REQUIRES a caller-provided ``rng`` (the filter's
+    seeded, lock-guarded generator — ``core/filters.FixingFloatFilter``) or
+    an explicit ``seed``.  It used to fall back to an unseeded
+    ``np.random.default_rng()`` per call, which silently broke the repo-wide
+    seeded-determinism contract (every other randomness source — chaos
+    schedules, data shards, noise filters — replays bitwise from a seed).
+    """
     x = np.asarray(x, np.float32)
     if per_row and x.ndim >= 2:
         amax = np.max(np.abs(x), axis=tuple(range(1, x.ndim)), keepdims=True)
@@ -32,7 +41,14 @@ def quantize_int8(
     scale = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
     y = x / scale
     if stochastic:
-        rng = rng or np.random.default_rng()
+        if rng is None:
+            if seed is None:
+                raise ValueError(
+                    "quantize_int8(stochastic=True) needs rng= or seed=: an "
+                    "implicit unseeded generator would break seeded replay "
+                    "determinism (thread one from the filter config instead)"
+                )
+            rng = np.random.default_rng(seed)
         y = np.floor(y + rng.random(y.shape, dtype=np.float32))
     else:
         y = np.rint(y)

@@ -59,6 +59,51 @@ def test_catches_counters_recursion(tmp_path):
     assert "DoubleCountVan.counters" in problems[0]
 
 
+def test_frame_hot_path_is_pickle_free():
+    """The flat-frame hot path (codec, transport, resender, coalescer) must
+    never re-import pickle — the serialize tax ISSUE 7 removed."""
+    problems = []
+    for rel in check_wrappers.NO_PICKLE_MODULES:
+        path = REPO / "parameter_server_tpu" / rel
+        assert path.is_file(), f"hot-path module moved: {rel}"
+        problems.extend(check_wrappers.check_no_pickle(path))
+    assert problems == [], "\n".join(problems)
+
+
+def test_catches_pickle_import_on_hot_path(tmp_path):
+    bad = tmp_path / "bad_codec.py"
+    bad.write_text(
+        textwrap.dedent(
+            """
+            import pickle
+            from pickle import dumps
+
+            def encode(msg):
+                return pickle.dumps(msg)
+            """
+        )
+    )
+    problems = check_wrappers.check_no_pickle(bad)
+    assert len(problems) == 2
+    assert "pickle" in problems[0]
+
+
+def test_no_pickle_allows_clean_module(tmp_path):
+    ok = tmp_path / "ok_codec.py"
+    ok.write_text("import struct\nimport zlib\n")
+    assert check_wrappers.check_no_pickle(ok) == []
+
+
+def test_main_fails_loudly_if_hot_path_module_missing(tmp_path, monkeypatch):
+    """NO_PICKLE_MODULES entries must exist when scanning the real package;
+    a rename must fail the check, not silently skip the ban."""
+    monkeypatch.setattr(
+        check_wrappers, "NO_PICKLE_MODULES",
+        check_wrappers.NO_PICKLE_MODULES + ("core/renamed_codec.py",),
+    )
+    assert check_wrappers.main(["check_wrappers"]) == 1
+
+
 def test_accepts_super_delegation(tmp_path):
     ok = tmp_path / "ok_van.py"
     ok.write_text(

@@ -18,6 +18,15 @@ PR 6, nothing enforced:
    a layer that also merged its inner's counters would double-count every
    key below it.
 
+3. **No pickle on the frame hot path.**  The flat wire codec
+   (``core/frame.py`` + its users ``core/tcp_van.py``, ``core/resender.py``,
+   ``core/coalesce.py``) exists to kill the per-message pickle serialize/
+   copy tax; an ``import pickle`` (or ``cPickle``/``dill``) creeping back
+   into any of those modules silently re-introduces it — and puts
+   arbitrary-code-execution deserialization back on a network-facing path.
+   Enforced as a module-level import ban on :data:`NO_PICKLE_MODULES`
+   (``check_no_pickle``).
+
 Pure-AST check (no imports of the checked modules), so it runs in any
 environment and is wired as a tier-1 test (``tests/test_wrapper_contract.py``).
 Exit code 0 = clean; 1 = violations (one line each).
@@ -34,6 +43,22 @@ PKG = pathlib.Path(__file__).resolve().parent.parent / "parameter_server_tpu"
 
 #: methods that must delegate to the inner van when overridden.
 DELEGATING = ("flush", "close")
+
+#: frame hot-path modules where any pickle-family import is banned —
+#: encode/decode (tcp_van + frame), stamp/verify (resender), bundling
+#: (coalesce).  Paths relative to the package root.
+NO_PICKLE_MODULES = (
+    "core/frame.py",
+    "core/tcp_van.py",
+    "core/resender.py",
+    "core/coalesce.py",
+)
+
+#: module names whose import re-introduces the serialization tax (and an
+#: arbitrary-code-execution decode) on the hot path.
+_PICKLE_NAMES = frozenset(
+    {"pickle", "cPickle", "_pickle", "dill", "cloudpickle", "marshal"}
+)
 
 
 def _base_names(cls: ast.ClassDef) -> List[str]:
@@ -116,13 +141,42 @@ def check_file(path: pathlib.Path) -> List[str]:
     return problems
 
 
+def check_no_pickle(path: pathlib.Path) -> List[str]:
+    """Ban pickle-family imports anywhere in ``path`` (module or nested)."""
+    tree = ast.parse(path.read_text(), filename=str(path))
+    problems: List[str] = []
+    for node in ast.walk(tree):
+        names: List[str] = []
+        if isinstance(node, ast.Import):
+            names = [a.name.split(".")[0] for a in node.names]
+        elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+            names = [node.module.split(".")[0]]
+        for name in names:
+            if name in _PICKLE_NAMES:
+                problems.append(
+                    f"{_rel(path)}:{node.lineno}: imports {name!r} — the "
+                    "frame hot path is pickle-free by contract (flat binary "
+                    "codec in core/frame.py); route any object serialization "
+                    "through the meta codec instead"
+                )
+    return problems
+
+
 def main(argv: List[str]) -> int:
     roots = [pathlib.Path(a) for a in argv[1:]] or [PKG]
     problems: List[str] = []
     found_wrapper = False
+    found_hot_path = 0
     for root in roots:
         files = [root] if root.is_file() else sorted(root.rglob("*.py"))
         for f in files:
+            try:
+                rel = str(f.resolve().relative_to(PKG)).replace("\\", "/")
+            except ValueError:
+                rel = None
+            if rel in NO_PICKLE_MODULES:
+                found_hot_path += 1
+                problems.extend(check_no_pickle(f))
             text = f.read_text()
             if "VanWrapper" not in text:
                 continue
@@ -131,6 +185,16 @@ def main(argv: List[str]) -> int:
     if not found_wrapper:
         print("check_wrappers: no VanWrapper subclasses found", file=sys.stderr)
         return 1  # a rename must fail loudly, not pass vacuously
+    if roots == [PKG] and found_hot_path != len(NO_PICKLE_MODULES):
+        # same loud-failure stance: a moved/renamed hot-path module must not
+        # let the pickle ban pass vacuously
+        print(
+            "check_wrappers: only "
+            f"{found_hot_path}/{len(NO_PICKLE_MODULES)} no-pickle hot-path "
+            "modules found — update NO_PICKLE_MODULES",
+            file=sys.stderr,
+        )
+        return 1
     for p in problems:
         print(p)
     return 1 if problems else 0
