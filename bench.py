@@ -1739,6 +1739,8 @@ def run_wire() -> tuple[dict, list[str]]:
 
 
 def record_wire(record: dict, lines: list[str]) -> None:
+    from parameter_server_tpu.core import frame
+
     stamp = time.strftime("%Y-%m-%d %H:%M:%S UTC", time.gmtime())
     rows_md = "".join(
         f"| {name} | {s['plane_bytes']:,} | {s['pickle_overhead_bytes']} | "
@@ -1753,7 +1755,8 @@ def record_wire(record: dict, lines: list[str]) -> None:
         "|---|---|---|---|---|---|---|\n" + rows_md +
         "\nBoth columns produce CRC-covered wire bytes; the flat codec "
         "folds the plane CRC into the encode pass (zero tobytes() copies) "
-        "and carries resender stamps in the fixed 48-byte header.\n"
+        "and carries resender stamps in the fixed "
+        f"{frame.HEADER_SIZE}-byte header.\n"
     )
     _splice_baseline(
         _WIRE_BEGIN,

@@ -5,7 +5,7 @@ into few large ranged messages; PR 1's :class:`ReliableVan` made every frame
 carry ACK/seq bookkeeping, so per-message overhead got *more* expensive.
 :class:`CoalescingVan` amortizes it: PUSH/PULL messages headed for the same
 link inside a flush window are merged into a single bundle frame — one
-48-byte flat-frame header (``core/frame.py``), one seq/ACK leg, one filter
+52-byte flat-frame header (``core/frame.py``), one seq/ACK leg, one filter
 pass (key-cache / zlib / int8 quant see the concatenated arrays), one wire
 message.  Bundling is re-encode-free by construction: member value arrays
 become planes of the ONE bundle frame (the codec joins their buffers

@@ -3,16 +3,17 @@
 Replaces the pickle framing of ``core/tcp_van.py`` (ISSUE 7 tentpole).  A
 frame is::
 
-    [48-byte fixed header][meta section][key/value planes, back to back]
+    [52-byte fixed header][meta section][key/value planes, back to back]
 
 - **Fixed header** (little-endian, :data:`HEADER` layout): magic, version,
   Task kind, flags, array count, the transport stamps that every receiver
   wants *before* it touches the body — per-link sequence (``__rseq__``),
   sender incarnation (``__rinc__``), routing epoch (``__repoch__``), the
   resender's end-to-end payload CRC (``__rcrc__``) — plus the plane CRC32,
-  the meta/plane section lengths, and a CRC32 over the header bytes
-  themselves.  Dedup, incarnation fencing, and corruption rejection can all
-  be decided from fixed offsets without decoding the meta section.
+  the meta CRC32, the meta/plane section lengths, and a CRC32 over the
+  header bytes themselves.  Dedup, incarnation fencing, and corruption
+  rejection can all be decided from fixed offsets without decoding the
+  meta section.
 - **Meta section**: a compact tag-based binary encoding (``_enc_obj`` /
   ``_dec_obj`` — NO pickle on this path, enforced by
   ``tools/check_wrappers.py``) of the Task strings and payload dict,
@@ -26,15 +27,19 @@ frame is::
   back as ``np.frombuffer`` views over the received buffer (zero copies on
   receive — the SArray role end to end).
 
-CRC layering: the header's ``plane_crc`` covers the frame's plane bytes AS
-ENCODED (post-filter), computed incrementally over the plane memoryviews
-during the same pass that writes them; receivers verify it in one pass over
-the raw buffer before any numpy reconstruction.  It is deliberately NOT the
-resender's ``__rcrc__`` stamp — that one is computed ABOVE the base van's
-filter chain (pre-compression/quantization) and stays the end-to-end
-integrity check; the header CRC catches wire-level corruption at the
-transport boundary, typed (:class:`FrameError`) instead of a recv-thread
-exception.
+CRC layering: every frame section has its own check.  ``header_crc``
+covers the fixed header bytes; ``meta_crc`` covers the meta section (Task
+strings, payload dict, plane manifests — verified in :func:`decode` before
+any meta parsing, so a flipped meta bit is a typed reject, never a garbled
+payload delivered upstream or an untyped parse error on the recv thread);
+``plane_crc`` covers the frame's plane bytes AS ENCODED (post-filter),
+computed incrementally over the plane memoryviews during the same pass
+that writes them and verified in one pass over the raw buffer before any
+numpy reconstruction.  None of these is the resender's ``__rcrc__`` stamp
+— that one is computed ABOVE the base van's filter chain
+(pre-compression/quantization) and stays the end-to-end integrity check;
+the header/meta/plane CRCs catch wire-level corruption at the transport
+boundary, typed (:class:`FrameError`) instead of a recv-thread exception.
 
 Stamp lifting is loss-free: :func:`encode` pops the stamp keys out of the
 payload into header fields, :func:`decode` reinstates them, so every layer
@@ -78,7 +83,7 @@ ROUTING_EPOCH_KEY = "__repoch__"
 MAGIC = b"PF"
 VERSION = 1
 
-#: fixed header layout (48 bytes, little-endian).
+#: fixed header layout (52 bytes, little-endian).
 HEADER = struct.Struct(
     "<2s"  # magic
     "B"    # version
@@ -90,11 +95,12 @@ HEADER = struct.Struct(
     "i"    # epoch      (valid iff FLAG_EPOCH)
     "I"    # e2e_crc    (valid iff FLAG_E2E_CRC — the resender's __rcrc__)
     "I"    # plane_crc32 over the plane bytes as framed
+    "I"    # meta_crc32 over the meta section bytes
     "I"    # meta_len
     "Q"    # planes_len
-    "I"    # header_crc32 over the 44 bytes above
+    "I"    # header_crc32 over the 48 bytes above
 )
-HEADER_SIZE = HEADER.size  # 48
+HEADER_SIZE = HEADER.size  # 52
 
 FLAG_REQUEST = 1 << 0
 FLAG_HAS_KEYS = 1 << 1
@@ -404,6 +410,11 @@ def _dec_obj(buf, pos: int) -> Tuple[Any, int]:
             pos += 8 * ndim
             n = 1
             for d in shape:
+                if d < 0:
+                    # a negative dim makes the truncation check below pass
+                    # (negative nbytes), frombuffer read to the buffer end,
+                    # and pos move BACKWARDS — silent mis-parse, not reject
+                    raise FrameError(f"negative ndarray dim {d} in meta")
                 n *= d
             nbytes = n * dt.itemsize
             if pos + nbytes > len(buf):
@@ -413,11 +424,27 @@ def _dec_obj(buf, pos: int) -> Tuple[Any, int]:
         raise FrameError(f"unknown meta tag {tag}")
     except FrameError:
         raise
-    except (IndexError, struct.error, UnicodeDecodeError, TypeError) as e:
+    except (IndexError, struct.error, UnicodeDecodeError, TypeError,
+            ValueError, OverflowError) as e:
+        # garbled bytes surface as many exception types (np.dtype parse,
+        # frombuffer size math, int-to-ssize_t overflow, ...); ALL of them
+        # must become the one typed reject the recv thread catches
         raise FrameError(f"garbled meta section: {e}") from e
 
 
 # ------------------------------------------------------------ frame codec
+
+
+#: stamp key -> the header-field range ``encode`` lifts it within; values
+#: outside (or non-int) ride the meta section instead (flag unset).
+#: ``frame_nbytes`` filters by the SAME ranges so its estimate stays exact
+#: for out-of-range stamp values.
+_STAMP_RANGES = {
+    SEQ_KEY: (_I64_MIN, _I64_MAX),
+    INCARNATION_KEY: (_I32_MIN, _I32_MAX),
+    ROUTING_EPOCH_KEY: (_I32_MIN, _I32_MAX),
+    CRC_KEY: (0, 0xFFFFFFFF),
+}
 
 
 def _lift_int(payload: dict, key: str, lo: int, hi: int):
@@ -448,10 +475,12 @@ def encode(msg: Message) -> bytes:
             for k, v in payload.items()
             # only int values of header width lift; anything else rides meta
         }
-        seq = _lift_int(lifted, SEQ_KEY, _I64_MIN, _I64_MAX)
-        inc = _lift_int(lifted, INCARNATION_KEY, _I32_MIN, _I32_MAX)
-        epoch = _lift_int(lifted, ROUTING_EPOCH_KEY, _I32_MIN, _I32_MAX)
-        e2e = _lift_int(lifted, CRC_KEY, 0, 0xFFFFFFFF)
+        seq = _lift_int(lifted, SEQ_KEY, *_STAMP_RANGES[SEQ_KEY])
+        inc = _lift_int(lifted, INCARNATION_KEY,
+                        *_STAMP_RANGES[INCARNATION_KEY])
+        epoch = _lift_int(lifted, ROUTING_EPOCH_KEY,
+                          *_STAMP_RANGES[ROUTING_EPOCH_KEY])
+        e2e = _lift_int(lifted, CRC_KEY, *_STAMP_RANGES[CRC_KEY])
         payload = lifted
     if seq is not None:
         flags |= FLAG_SEQ
@@ -486,6 +515,15 @@ def encode(msg: Message) -> bytes:
         planes.append(mv)
         planes_len += len(mv)
 
+    if len(arrays) > 0xFFFF:
+        raise FrameError(
+            f"{len(arrays)} planes exceed the u16 n_arrays field "
+            "(split the bundle)"
+        )
+    if len(meta) > 0xFFFFFFFF:
+        raise FrameError(
+            f"{len(meta)}-byte meta section exceeds the u32 meta_len field"
+        )
     head = bytearray(HEADER_SIZE)
     HEADER.pack_into(
         head, 0,
@@ -499,6 +537,7 @@ def encode(msg: Message) -> bytes:
         epoch if epoch is not None else 0,
         e2e if e2e is not None else 0,
         plane_crc & 0xFFFFFFFF,
+        zlib.crc32(meta),
         len(meta),
         planes_len,
         0,  # header crc placeholder
@@ -522,6 +561,7 @@ class FrameInfo:
     epoch: Optional[int]
     e2e_crc: Optional[int]
     plane_crc: int
+    meta_crc: int
     meta_len: int
     planes_len: int
 
@@ -549,7 +589,8 @@ def peek(buf) -> FrameInfo:
         )
     (
         magic, version, kind_i, flags, n_arrays,
-        seq, inc, epoch, e2e, plane_crc, meta_len, planes_len, hcrc,
+        seq, inc, epoch, e2e, plane_crc, meta_crc, meta_len, planes_len,
+        hcrc,
     ) = HEADER.unpack_from(buf, 0)
     mv = memoryview(buf) if not isinstance(buf, memoryview) else buf
     if zlib.crc32(mv[: HEADER_SIZE - 4]) != hcrc:
@@ -575,6 +616,7 @@ def peek(buf) -> FrameInfo:
         epoch=epoch if flags & FLAG_EPOCH else None,
         e2e_crc=e2e if flags & FLAG_E2E_CRC else None,
         plane_crc=plane_crc,
+        meta_crc=meta_crc,
         meta_len=meta_len,
         planes_len=planes_len,
     )
@@ -598,7 +640,10 @@ def decode(buf, *, verify: bool = True) -> Message:
     BEFORE any meta decode or array reconstruction.  ``verify=False`` is
     for callers that intentionally decode damaged planes (ChaosVan's
     bit-flip injection, which relies on the resender's end-to-end CRC to
-    catch the corruption downstream).
+    catch the corruption downstream).  The meta CRC is checked on BOTH
+    paths: a garbled meta section cannot be parsed meaningfully, only
+    rejected (ChaosVan flips plane bytes exclusively, so this never fires
+    on its injection path).
     """
     info = peek(buf)
     mv = memoryview(buf) if not isinstance(buf, memoryview) else buf
@@ -607,6 +652,8 @@ def decode(buf, *, verify: bool = True) -> Message:
     pos = HEADER_SIZE
     meta_end = pos + info.meta_len
     meta = mv[pos:meta_end]
+    if zlib.crc32(meta) != info.meta_crc:
+        raise FrameError("meta CRC mismatch (corrupt meta section)")
     customer, p = _dec_obj(meta, 0)
     sender, p = _dec_obj(meta, p)
     recver, p = _dec_obj(meta, p)
@@ -627,8 +674,15 @@ def decode(buf, *, verify: bool = True) -> Message:
             p += 1
             shape = _shape_struct(ndim).unpack_from(meta, p) if ndim else ()
             p += 8 * ndim
+            if any(d < 0 for d in shape):
+                raise FrameError(f"negative plane dim in manifest: {shape}")
             manifests.append((dt, shape))
-    except (IndexError, struct.error, UnicodeDecodeError, TypeError) as e:
+    except FrameError:
+        raise
+    except (IndexError, struct.error, UnicodeDecodeError, TypeError,
+            ValueError, OverflowError) as e:
+        # same contract as _dec_obj: EVERY decode failure mode is the one
+        # typed reject — nothing escapes to kill the recv thread
         raise FrameError(f"garbled manifest block: {e}") from e
     # reinstate the lifted stamps: layers above the codec see the payload
     # dict bitwise as the sender's stack stamped it
@@ -651,7 +705,7 @@ def decode(buf, *, verify: bool = True) -> Message:
                 np.frombuffer(mv, dtype=dt, count=n, offset=off).reshape(shape)
             )
             off += n * dt.itemsize
-    except (ValueError, TypeError) as e:
+    except (ValueError, TypeError, OverflowError) as e:
         raise FrameError(f"garbled manifest: {e}") from e
     keys = arrays.pop(0) if info.flags & FLAG_HAS_KEYS else None
     return Message(
@@ -693,11 +747,15 @@ def frame_nbytes(msg: Message) -> Tuple[int, int]:
         manifest_len += 2 + len(_dtype_str(v.dtype)) + 8 * max(v.ndim, 1)
     payload = msg.task.payload
     if isinstance(payload, dict) and payload:
+        # drop exactly the stamps encode would lift: int-typed AND within
+        # the header field's range — an out-of-range stamp rides the meta
+        # section in the real frame, so it must stay in the estimate too
         payload = {
             k: v
             for k, v in payload.items()
-            if k not in (SEQ_KEY, INCARNATION_KEY, ROUTING_EPOCH_KEY, CRC_KEY)
+            if (r := _STAMP_RANGES.get(k)) is None
             or type(v) is not int
+            or not r[0] <= v <= r[1]
         }
     meta = bytearray()
     for name in (msg.task.customer, msg.sender, msg.recver):

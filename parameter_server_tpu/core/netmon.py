@@ -75,7 +75,7 @@ class _LinkStats:
         self.msgs = 0
         self.bytes = 0
         #: exact flat-frame wire size (``core/frame.py``): payload planes
-        #: PLUS the 48-byte fixed header and the encoded meta section —
+        #: PLUS the 52-byte fixed header and the encoded meta section —
         #: per-message framing tax, measured rather than modeled.
         self.frame_bytes = 0
         #: the non-plane share of ``frame_bytes`` (header + meta).
@@ -136,7 +136,7 @@ class MeteredVan(VanWrapper):
                 ),
             )
         # exact wire framing for this message as sent (incl. the __mts__
-        # stamp just added): plane bytes + 48-byte header + meta section.
+        # stamp just added): plane bytes + 52-byte header + meta section.
         # ``frame_nbytes`` sizes the meta without building the frame and
         # without touching device values; resender stamps added below ride
         # the fixed header (lifted), so they contribute zero meta bytes and

@@ -11,8 +11,9 @@ Design notes:
 - One ``TcpVan`` per *process*; multiple logical nodes (scheduler + servers +
   workers colocated on a host) may bind on it, exactly like LoopbackVan.
 - Wire format per frame: the flat self-describing layout of
-  ``core/frame.py`` — 48-byte fixed header (magic/version/kind/flags,
-  seq/incarnation/epoch stamps, plane CRC32, section lengths), a tag-encoded
+  ``core/frame.py`` — 52-byte fixed header (magic/version/kind/flags,
+  seq/incarnation/epoch stamps, plane+meta CRC32s, section lengths), a
+  tag-encoded
   binary meta section (NO pickle anywhere on this path), then the raw
   contiguous key/value planes.  Arrays ride as raw bytes both ways (the
   SArray zero-copy role: sends read array buffers directly, receives take
@@ -317,15 +318,28 @@ class TcpVan(Van):
             try:
                 msg = deserialize_message(memoryview(raw))
             except FrameError as e:
-                # typed rejection off the header (bad magic/version, header or
-                # plane CRC mismatch, truncation): count it and keep the recv
-                # thread alive — wire noise reads as loss, repaired by the
+                # typed rejection (bad magic/version, header/meta/plane CRC
+                # mismatch, truncation): count it and keep the recv thread
+                # alive — wire noise reads as loss, repaired by the
                 # resender's retransmit, never as a dead transport
                 with self._lock:
                     self.frame_rejects += 1
                     self.dropped_messages += 1
                 logging.getLogger(__name__).debug(
                     "tcpvan: rejecting %d-byte frame: %s", n, e
+                )
+                continue
+            except Exception:  # noqa: BLE001 — the codec's contract is that
+                # every decode failure is a FrameError, but this thread is a
+                # process-wide singleton: an exception type the codec missed
+                # must still read as one dropped frame, not dead reception
+                # for every node in the process
+                with self._lock:
+                    self.frame_rejects += 1
+                    self.dropped_messages += 1
+                logging.getLogger(__name__).exception(
+                    "tcpvan: untyped decode failure on %d-byte frame "
+                    "(codec bug — dropping frame)", n
                 )
                 continue
             if msg.sender:

@@ -203,6 +203,113 @@ def test_corrupt_plane_is_typed_reject_and_verify_false_tolerates():
     assert got.keys.shape == (10,)
 
 
+def _fuzz_msg():
+    """A frame whose meta exercises every decode path corruption can hit:
+    strings, nested containers, a payload ndarray, and plane manifests."""
+    return _msg(
+        task=Task(
+            TaskKind.PUSH,
+            "t",
+            payload={
+                "table": "w",
+                "scales": np.linspace(0.1, 1.0, 5, dtype=np.float32),
+                "nested": (1, [2, "x"], b"\x00\xff"),
+                "big": 1 << 80,
+            },
+        )
+    )
+
+
+def test_every_meta_bit_flip_is_typed_reject():
+    """Single-bit flips in the meta section — which used to escape as
+    OverflowError/ValueError off np.dtype/frombuffer and kill the recv
+    thread — must ALL be caught, by the meta CRC, as FrameError."""
+    good = frame.encode(_fuzz_msg())
+    info = frame.peek(good)
+    for off in range(frame.HEADER_SIZE, frame.HEADER_SIZE + info.meta_len):
+        for bit in (0, 3, 7):
+            buf = bytearray(good)
+            buf[off] ^= 1 << bit
+            with pytest.raises(FrameError):
+                frame.decode(bytes(buf))
+
+
+def test_fuzzed_frames_never_escape_frameerror():
+    """Multi-bit garbling + truncation anywhere in the frame: decode either
+    succeeds or raises FrameError — never any other exception type (the
+    recv-thread survival contract)."""
+    import random
+
+    good = frame.encode(_fuzz_msg())
+    rng = random.Random(7)
+    for _ in range(400):
+        buf = bytearray(good)
+        for _ in range(rng.randint(1, 4)):
+            buf[rng.randrange(len(buf))] ^= 1 << rng.randrange(8)
+        if rng.random() < 0.25:
+            buf = buf[: rng.randrange(len(buf))]
+        try:
+            frame.decode(bytes(buf))
+        except FrameError:
+            pass
+
+
+def _refix_crcs(buf: bytearray) -> bytes:
+    """Recompute meta+header CRCs so decode reaches the corrupted section
+    (tests the structural validation BEHIND the CRC line of defense)."""
+    import struct
+    import zlib
+
+    fields = list(frame.HEADER.unpack_from(buf, 0))
+    meta_len = fields[11]
+    meta = bytes(buf[frame.HEADER_SIZE : frame.HEADER_SIZE + meta_len])
+    fields[10] = zlib.crc32(meta)  # meta_crc32
+    frame.HEADER.pack_into(buf, 0, *fields[:-1], 0)
+    struct.pack_into(
+        "<I", buf, frame.HEADER_SIZE - 4,
+        zlib.crc32(bytes(buf[: frame.HEADER_SIZE - 4])),
+    )
+    return bytes(buf)
+
+
+def test_negative_manifest_dim_is_typed_reject():
+    """A manifest claiming a negative shape dim must be a typed reject,
+    not a silent mis-parse (frombuffer with negative count reads the whole
+    remaining buffer; reshape treats a lone -N as -1)."""
+    import struct
+
+    buf = bytearray(frame.encode(_msg()))
+    info = frame.peek(bytes(buf))
+    # the last 8 meta bytes are the final dim of the last plane's shape
+    # ((10, 4) float32 -> the 4)
+    end = frame.HEADER_SIZE + info.meta_len
+    assert struct.unpack_from("<q", buf, end - 8)[0] == 4
+    struct.pack_into("<q", buf, end - 8, -4)
+    with pytest.raises(FrameError, match="negative plane dim"):
+        frame.decode(_refix_crcs(buf))
+
+
+def test_negative_meta_ndarray_dim_is_typed_reject():
+    """Same validation inside the tag codec's _T_NDARRAY branch (payload
+    ndarrays: routing tables, q8 scales)."""
+    import struct
+
+    out = bytearray()
+    frame._enc_obj(np.arange(6, dtype=np.float32).reshape(2, 3), out)
+    # layout: tag(1) dlen(1) "float32"(7) ndim(1) dim0(8) dim1(8) data
+    struct.pack_into("<q", out, 1 + 1 + 7 + 1, -2)
+    with pytest.raises(FrameError, match="negative ndarray dim"):
+        frame._dec_obj(bytes(out), 0)
+
+
+def test_encode_overflowing_plane_count_is_typed_reject():
+    """> 65535 planes cannot fit the u16 n_arrays field: typed FrameError
+    at encode time, not a raw struct.error at send time."""
+    msg = _msg(keys=None, values=[np.zeros(1, dtype=np.float32)] * 65600)
+    with pytest.raises(FrameError, match="n_arrays"):
+        frame.encode(msg)
+
+
 # --------------------------------------------------- meta codec specifics
 
 
@@ -345,6 +452,15 @@ def test_frame_nbytes_is_exact():
              values=[np.arange(40, dtype=np.float32).reshape(10, 4),
                      np.arange(3, dtype=np.int32)]),
         _msg(values=[np.zeros((5, 2), dtype=ml_dtypes.bfloat16)]),
+        # out-of-range stamp values do NOT lift into the header — they ride
+        # the meta section, and the estimate must include them (the filter
+        # mirrors encode's _lift_int range checks, not just the key names)
+        _msg(task=Task(TaskKind.PUSH, "t",
+                       payload={"table": "w",
+                                resender_mod.SEQ_KEY: 1 << 70,
+                                resender_mod.CRC_KEY: 1 << 40,
+                                INCARNATION_KEY: -(1 << 40),
+                                routing_mod.ROUTING_EPOCH_KEY: 1 << 35})),
     ]
     for msg in cases:
         buf = frame.encode(msg)
