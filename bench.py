@@ -1767,6 +1767,148 @@ def record_wire(record: dict, lines: list[str]) -> None:
     )
 
 
+# -- Observability overhead: flight recorder + metering tax (ISSUE 8) ------
+
+_OBS_BEGIN = "<!-- BENCH-OBS:BEGIN -->"
+_OBS_END = "<!-- BENCH-OBS:END -->"
+
+_OBS_STEPS = 60
+_OBS_WARMUP = 8
+_OBS_REPEATS = 4
+#: the guard: fully-on observability must cost <= this vs recorder-off.
+_OBS_BUDGET_PCT = 3.0
+#: headline-proportionate workload shape: the headline criteo run is batch
+#: 16384 x nnz 39; this CPU-sized replica keeps the same structure (per-step
+#: message count is topology-fixed at ~8, payload scales with batch x nnz)
+#: so per-message observability costs amortize exactly as they do there.
+_OBS_BATCH = 2048
+_OBS_NNZ = 26
+
+
+def _obs_run(*, observability: bool) -> float:
+    """Seconds for ``_OBS_STEPS`` sparse-LR train steps over a loopback KV
+    cluster — the headline pull/grad/push loop shape — with the whole
+    observability plane (MeteredVan + flight recorder) on or off."""
+    import jax.numpy as jnp
+
+    from parameter_server_tpu.config import OptimizerConfig, TableConfig
+    from parameter_server_tpu.core import flightrec
+    from parameter_server_tpu.core.netmon import MeteredVan
+    from parameter_server_tpu.core.postoffice import Postoffice
+    from parameter_server_tpu.core.van import LoopbackVan
+    from parameter_server_tpu.data.synthetic import SyntheticCTR
+    from parameter_server_tpu.kv.server import KVServer
+    from parameter_server_tpu.kv.worker import KVWorker
+    from parameter_server_tpu.models import linear
+
+    rows = 1 << 16
+    cfgs = {
+        "w": TableConfig(
+            name="w", rows=rows, dim=1,
+            optimizer=OptimizerConfig(kind="adagrad", learning_rate=0.1),
+        )
+    }
+    base = LoopbackVan()
+    van = MeteredVan(base) if observability else base
+    flightrec.configure(enabled=observability, clear=True)
+    try:
+        servers = [
+            KVServer(Postoffice(f"S{s}", van), cfgs, s, 2) for s in range(2)
+        ]
+        worker = KVWorker(Postoffice("W0", van), cfgs, 2)
+        data = SyntheticCTR(
+            key_space=4 * rows, nnz=_OBS_NNZ, batch_size=_OBS_BATCH, seed=5
+        )
+        batches = [data.next_batch() for _ in range(_OBS_WARMUP + _OBS_STEPS)]
+
+        def step(keys, labels):
+            w_pos = worker.pull_sync("w", keys, timeout=60)
+            g, _gb, _loss = linear.grad_rows(
+                jnp.asarray(w_pos), jnp.asarray(labels)
+            )
+            worker.push_sync(
+                "w", keys, np.asarray(g) / labels.shape[0], timeout=60
+            )
+
+        for keys, labels in batches[:_OBS_WARMUP]:  # compile + caches warm
+            step(keys, labels)
+        # per-step timing, MEDIAN taken: shared-host CPU bursts inflate a
+        # tail of steps by 3-10x, which a total-wall-clock measurement
+        # cannot separate from a few-percent systematic overhead
+        samples = []
+        for keys, labels in batches[_OBS_WARMUP:]:
+            t0 = time.perf_counter()
+            step(keys, labels)
+            samples.append(time.perf_counter() - t0)
+        del servers
+        samples.sort()
+        return samples[len(samples) // 2]
+    finally:
+        van.close()
+        flightrec.configure(enabled=True, clear=True)
+
+
+def run_obs() -> tuple[dict, list[str]]:
+    """The ISSUE 8 guard: the headline sparse-LR loop with the recorder AND
+    MeteredVan fully on must stay within ``_OBS_BUDGET_PCT`` of the same
+    loop with everything off.  Arms interleave, each run reports its MEDIAN
+    per-step time, and the min over repeats is compared — the double
+    robustification a shared noisy host needs before a 3% bound means
+    anything.  Host-only: no device, no probe."""
+    on_s, off_s = [], []
+    for _ in range(_OBS_REPEATS):
+        off_s.append(_obs_run(observability=False))
+        on_s.append(_obs_run(observability=True))
+    t_on, t_off = min(on_s), min(off_s)
+    overhead_pct = (t_on - t_off) / t_off * 100.0
+    passed = overhead_pct <= _OBS_BUDGET_PCT
+    lines = [
+        f"obs overhead: recorder+metering on {t_on * 1e3:.3f} "
+        f"ms/step vs off {t_off * 1e3:.3f} ms/step "
+        f"-> {overhead_pct:+.2f}% (budget {_OBS_BUDGET_PCT}%): "
+        f"{'PASS' if passed else 'FAIL'}",
+        f"median-step repeats (ms) on={[round(s * 1e3, 3) for s in on_s]} "
+        f"off={[round(s * 1e3, 3) for s in off_s]}",
+    ]
+    record = {
+        "metric": "observability_overhead_pct",
+        "value": round(overhead_pct, 3),
+        "unit": "%",
+        "vs_baseline": _OBS_BUDGET_PCT,
+        "pass": passed,
+        "on_ms_per_step": round(t_on * 1e3, 4),
+        "off_ms_per_step": round(t_off * 1e3, 4),
+        "steps": _OBS_STEPS,
+        "repeats": _OBS_REPEATS,
+    }
+    return record, lines
+
+
+def record_obs(record: dict, lines: list[str]) -> None:
+    stamp = time.strftime("%Y-%m-%d %H:%M:%S UTC", time.gmtime())
+    body = (
+        f"\n{stamp}; {record['steps']} sparse-LR steps "
+        f"(batch {_OBS_BATCH}, nnz {_OBS_NNZ}, headline-proportionate) x "
+        f"{record['repeats']} interleaved repeats, host CPU only, "
+        "min-over-repeats compared.\n\n"
+        "| arm | ms/step |\n|---|---|\n"
+        f"| recorder + MeteredVan fully on | {record['on_ms_per_step']} |\n"
+        f"| observability off | {record['off_ms_per_step']} |\n\n"
+        f"Overhead: **{record['value']:+.2f}%** against a "
+        f"{_OBS_BUDGET_PCT}% budget — "
+        f"{'PASS' if record['pass'] else 'FAIL'}.  The flight recorder's "
+        "per-event cost is one dict build + a GIL-atomic deque append; "
+        "MeteredVan adds a histogram bucket per delivery.\n"
+    )
+    _splice_baseline(
+        _OBS_BEGIN,
+        _OBS_END,
+        body,
+        "## Observability overhead: flight recorder + metering "
+        "(auto-recorded by bench.py --obs)",
+    )
+
+
 # -- DLRM at scale: billion-row table proof (VERDICT r4 #3) ----------------
 
 _DLRM_SUBPROC_TIMEOUT_S = 1200.0
@@ -2978,6 +3120,32 @@ def _dispatch() -> None:
         _emit(record)
         print("\n".join(lines), file=sys.stderr)
         record_wire(record, lines)
+        return
+    if "--obs" in sys.argv[1:]:
+        # host-side only: loopback KV loop on CPU jax, no TPU probe
+        from parameter_server_tpu.utils.platform import force_cpu
+
+        force_cpu()
+        _start_watchdog("observability_overhead_pct", "%")
+        try:
+            record, lines = run_obs()
+        except Exception as e:  # noqa: BLE001 — the JSON line must still emit
+            _emit(
+                {
+                    "metric": "observability_overhead_pct",
+                    "value": 0.0,
+                    "unit": "%",
+                    "vs_baseline": None,
+                    "error": f"obs failed: {type(e).__name__}: {e}"[:500],
+                }
+            )
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
+            return
+        _emit(record)
+        print("\n".join(lines), file=sys.stderr)
+        record_obs(record, lines)
         return
     if micro:
         _start_watchdog("micro_scatter_add_pallas_speedup_vs_xla", "x")

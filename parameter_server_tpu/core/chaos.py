@@ -64,7 +64,7 @@ from typing import Callable, Dict, Optional, Tuple
 
 import numpy as np
 
-from parameter_server_tpu.core import frame
+from parameter_server_tpu.core import flightrec, frame
 from parameter_server_tpu.core.messages import Message
 from parameter_server_tpu.core.van import Van, VanWrapper
 
@@ -435,16 +435,28 @@ class ChaosVan(VanWrapper):
             if u_drop < cfg.drop:
                 with self._lock:
                     self.injected_drops += 1
+                flightrec.record(
+                    "chaos.inject", fault="drop",
+                    node=msg.sender, recver=msg.recver,
+                )
                 return True
             if u_dup < cfg.duplicate:
                 copies = 2
                 with self._lock:
                     self.injected_dups += 1
+                flightrec.record(
+                    "chaos.inject", fault="dup",
+                    node=msg.sender, recver=msg.recver,
+                )
             latency += cfg.delay + u_jit * cfg.jitter
             if u_reord < cfg.reorder:
                 latency += cfg.reorder_delay
                 with self._lock:
                     self.injected_reorders += 1
+                flightrec.record(
+                    "chaos.inject", fault="reorder",
+                    node=msg.sender, recver=msg.recver,
+                )
         if slow > 0.0:
             with self._lock:
                 self.injected_slow += 1
@@ -454,6 +466,10 @@ class ChaosVan(VanWrapper):
                 msg = flipped
                 with self._lock:
                     self.injected_corrupt += 1
+                flightrec.record(
+                    "chaos.inject", fault="corrupt",
+                    node=msg.sender, recver=msg.recver,
+                )
         if latency <= 0.0:
             # synchronous path: per-link FIFO preserved exactly (duplicates
             # arrive back to back, like an eager retransmitter)

@@ -50,6 +50,7 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from parameter_server_tpu.core import flightrec
 from parameter_server_tpu.core.messages import Message, Task, TaskKind
 from parameter_server_tpu.core.van import Van, VanWrapper
 
@@ -283,6 +284,11 @@ class CoalescingVan(VanWrapper):
                 self._msgs += len(subs)
             frame = subs[0] if len(subs) == 1 else _pack(subs)
             ok = self.inner.send(frame)
+        if len(subs) > 1:
+            flightrec.record(
+                "bundle.flush", node=link[0], recver=link[1],
+                subs=len(subs), ok=ok,
+            )
         if not ok:
             self._deliver_errors(subs)
 

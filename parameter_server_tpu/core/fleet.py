@@ -26,12 +26,68 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 import statistics
 import threading
 import time
 from typing import IO, Dict, List, Optional
 
 from parameter_server_tpu.utils.trace import LatencyHistogram
+
+
+class RotatingJsonlWriter:
+    """Size-rotated JSONL sink writing WHOLE lines only.
+
+    Each :meth:`write_line` is one ``write()`` call of a complete
+    ``...\\n``-terminated line followed by ``flush()``, and rotation happens
+    BETWEEN lines (the current file is renamed to ``<path>.<n>`` and a fresh
+    one opened), so no reader — and no postmortem bundle — can ever capture
+    a truncated last line.  :meth:`sync` adds an fsync for the dump path.
+    """
+
+    def __init__(self, path: str, *, rotate_bytes: int = 0) -> None:
+        self.path = path
+        self.rotate_bytes = rotate_bytes
+        self._lock = threading.Lock()
+        self._rotations = 0
+        self._f = open(path, "a")
+        self._size = self._f.tell()
+
+    def write_line(self, line: str) -> None:
+        if not line.endswith("\n"):
+            line += "\n"
+        with self._lock:
+            if (
+                self.rotate_bytes > 0
+                and self._size > 0
+                and self._size + len(line) > self.rotate_bytes
+            ):
+                self._rotate_locked()
+            self._f.write(line)
+            self._f.flush()
+            self._size += len(line)
+
+    def _rotate_locked(self) -> None:
+        self._f.close()
+        self._rotations += 1
+        os.replace(self.path, f"{self.path}.{self._rotations}")
+        self._f = open(self.path, "a")
+        self._size = 0
+
+    @property
+    def rotations(self) -> int:
+        with self._lock:
+            return self._rotations
+
+    def sync(self) -> None:
+        """Flush + fsync (the flush-on-dump guarantee for bundles)."""
+        with self._lock:
+            self._f.flush()
+            os.fsync(self._f.fileno())
+
+    def close(self) -> None:
+        with self._lock:
+            self._f.close()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -91,9 +147,22 @@ class FleetMonitor:
         policy: Optional[StragglerPolicy] = None,
         window: int = 256,
         jsonl: Optional[IO[str]] = None,
+        jsonl_path: Optional[str] = None,
+        rotate_bytes: int = 0,
     ) -> None:
+        """``jsonl``: an open text stream (legacy form, no rotation), or
+        ``jsonl_path``: a file path managed through a
+        :class:`RotatingJsonlWriter` with ``rotate_bytes`` size rotation
+        (0 = never rotate).  Mutually exclusive."""
+        if jsonl is not None and jsonl_path is not None:
+            raise ValueError("pass jsonl OR jsonl_path, not both")
         self.policy = policy or StragglerPolicy()
         self.jsonl = jsonl
+        self.jsonl_writer: Optional[RotatingJsonlWriter] = (
+            RotatingJsonlWriter(jsonl_path, rotate_bytes=rotate_bytes)
+            if jsonl_path is not None
+            else None
+        )
         self._window = window
         self._lock = threading.Lock()
         self._series: Dict[str, _NodeSeries] = {}
@@ -297,7 +366,7 @@ class FleetMonitor:
         Returns the row (or None without a sink).  Call per monitor sweep;
         one line = one fleet-wide observation, replayable offline.
         """
-        if self.jsonl is None:
+        if self.jsonl is None and self.jsonl_writer is None:
             return None
         now = time.monotonic() if now is None else now
         row = {
@@ -305,6 +374,24 @@ class FleetMonitor:
             "nodes": self.snapshot(now),
             "stragglers": self.stragglers(now),
         }
-        self.jsonl.write(json.dumps(row) + "\n")
-        self.jsonl.flush()
+        line = json.dumps(row) + "\n"
+        if self.jsonl_writer is not None:
+            self.jsonl_writer.write_line(line)
+        else:
+            self.jsonl.write(line)
+            self.jsonl.flush()
         return row
+
+    def flush_jsonl(self) -> None:
+        """Durably flush the JSONL sink (called by ``flightrec`` bundle
+        dumps — the no-truncated-last-line guarantee)."""
+        if self.jsonl_writer is not None:
+            self.jsonl_writer.sync()
+        elif self.jsonl is not None:
+            self.jsonl.flush()
+            fileno = getattr(self.jsonl, "fileno", None)
+            if fileno is not None:
+                try:
+                    os.fsync(fileno())
+                except (OSError, ValueError):
+                    pass  # StringIO and friends have no real fd

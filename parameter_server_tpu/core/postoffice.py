@@ -19,6 +19,7 @@ import logging
 import threading
 from typing import Callable, Optional
 
+from parameter_server_tpu.core import flightrec
 from parameter_server_tpu.core.messages import (
     Message,
     Task,
@@ -63,6 +64,10 @@ class Postoffice:
             raise ValueError(f"customer {customer.name!r} already registered")
         self._customers[customer.name] = customer
 
+    def counters(self) -> dict:
+        """Dashboard-mergeable fence counters (utils.metrics attachments)."""
+        return {"cancelled_drops": self.cancelled_drops}
+
     def send(self, msg: Message) -> bool:
         msg.sender = self.node_id
         return self.van.send(msg)
@@ -104,6 +109,10 @@ class Postoffice:
             msg.sender, msg.task.customer, msg.task.time
         ):
             self.cancelled_drops += 1
+            flightrec.record(
+                "cancel.drop", node=self.node_id, sender=msg.sender,
+                customer=msg.task.customer, ts=msg.task.time,
+            )
             logging.getLogger(__name__).info(
                 "%s: dropped cancelled request ts=%s from %s/%s",
                 self.node_id,

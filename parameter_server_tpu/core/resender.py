@@ -73,6 +73,7 @@ from typing import Callable, Dict, Optional, Tuple
 
 import numpy as np
 
+from parameter_server_tpu.core import flightrec
 from parameter_server_tpu.core.frame import plane_view
 from parameter_server_tpu.core.messages import (
     INCARNATION_KEY,
@@ -244,6 +245,10 @@ class ReliableVan(VanWrapper):
                 # dead process its corruption landed.
                 with self._lock:
                     self.rejected_stale += 1
+                flightrec.record(
+                    "fence.incarnation", node=msg.recver,
+                    sender=msg.sender, inc=inc, known=known, seq=seq,
+                )
                 return
             crc = msg.task.payload.get(CRC_KEY)
             if crc is not None and self.integrity:
@@ -252,6 +257,10 @@ class ReliableVan(VanWrapper):
                     # retransmit (its copy is intact) repairs it like a loss
                     with self._lock:
                         self.rejected_corrupt += 1
+                    flightrec.record(
+                        "frame.reject", node=msg.recver, reason="crc",
+                        sender=msg.sender, seq=seq,
+                    )
                     return
             link = (msg.sender, msg.recver)
             if inc > known and self.incarnations.learn(msg.sender, inc):
@@ -268,6 +277,10 @@ class ReliableVan(VanWrapper):
                 if not is_fresh:
                     self.dup_suppressed += 1
             if not is_fresh:
+                flightrec.record(
+                    "resend.dup", node=msg.recver,
+                    sender=msg.sender, seq=seq,
+                )
                 return
             # strip the stamps: replies share this Task's payload dict, and
             # a stale inherited seq would corrupt the reply link's dedup
@@ -381,10 +394,18 @@ class ReliableVan(VanWrapper):
                     )
                     continue
             for p in resend:
+                flightrec.record(
+                    "resend.retransmit", node=p.link[0],
+                    recver=p.link[1], seq=p.seq, attempt=p.attempts,
+                )
                 # send-time failure here is NOT fatal: the identity may be
                 # rebound (promotion) before the budget runs out
                 self.inner.send(p.msg)
             for p in dead:
+                flightrec.record(
+                    "resend.gave_up", node=p.link[0],
+                    recver=p.link[1], seq=p.seq, attempts=p.attempts - 1,
+                )
                 _log.warning(
                     "resender: gave up on %s->%s seq=%s after %d attempts",
                     p.link[0], p.link[1], p.seq, p.attempts - 1,
@@ -412,6 +433,9 @@ class ReliableVan(VanWrapper):
         """
         if not self.incarnations.learn(node_id, incarnation):
             return False
+        flightrec.record(
+            "incarnation.advance", node=node_id, inc=incarnation,
+        )
         self._reset_sender_windows(node_id)
         with self._lock:
             for link in [l for l in self._next_seq if l[0] == node_id]:

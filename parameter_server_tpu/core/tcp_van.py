@@ -35,7 +35,7 @@ import threading
 from typing import Callable, Dict, Optional, Tuple
 
 from parameter_server_tpu import native
-from parameter_server_tpu.core import frame
+from parameter_server_tpu.core import flightrec, frame
 from parameter_server_tpu.core.frame import FrameError
 from parameter_server_tpu.core.messages import Message
 from parameter_server_tpu.core.van import Van, _Endpoint
@@ -325,6 +325,10 @@ class TcpVan(Van):
                 with self._lock:
                     self.frame_rejects += 1
                     self.dropped_messages += 1
+                flightrec.record(
+                    "frame.reject", reason="decode", nbytes=n,
+                    error=str(e)[:120],
+                )
                 logging.getLogger(__name__).debug(
                     "tcpvan: rejecting %d-byte frame: %s", n, e
                 )
@@ -337,6 +341,9 @@ class TcpVan(Van):
                 with self._lock:
                     self.frame_rejects += 1
                     self.dropped_messages += 1
+                flightrec.record(
+                    "frame.reject", reason="codec-bug", nbytes=n,
+                )
                 logging.getLogger(__name__).exception(
                     "tcpvan: untyped decode failure on %d-byte frame "
                     "(codec bug — dropping frame)", n

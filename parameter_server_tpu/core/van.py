@@ -116,13 +116,21 @@ class _Endpoint:
                 return
             try:
                 self.handler(msg)
-            except Exception:  # noqa: BLE001 — a bad message must not kill
-                # the node's only receive thread (all later messages for the
-                # node would silently queue forever)
+            except Exception as e:  # noqa: BLE001 — a bad message must not
+                # kill the node's only receive thread (all later messages for
+                # the node would silently queue forever)
                 logging.getLogger(__name__).exception(
                     "van: handler error on node %r; message dropped",
                     self.node_id,
                 )
+                # black-box trigger: journal the exception and, when a dump
+                # dir is configured, capture the ring before it wraps
+                try:
+                    from parameter_server_tpu.core import flightrec
+
+                    flightrec.on_recv_exception(self.node_id, e)
+                except Exception:  # noqa: BLE001 — observability must never
+                    pass  # take down the recv thread it exists to debug
 
     def stop(self) -> None:
         self.inbox.put(None)

@@ -33,6 +33,7 @@ from typing import Optional
 
 import numpy as np
 
+from parameter_server_tpu.core import flightrec
 from parameter_server_tpu.core.messages import Message, Task, TaskKind, server_id
 from parameter_server_tpu.core.postoffice import Customer, Postoffice
 from parameter_server_tpu.kv.routing import RoutingTable
@@ -164,8 +165,12 @@ class ShardMigrator(Customer):
                 },
             )
             self.freeze_s_last = float(np.asarray(reply.values[0])[0])
-        except MigrationError:
+        except MigrationError as e:
             self.aborts += 1
+            flightrec.record(
+                "migrate.abort", node=self.post.node_id, mid=mid,
+                donor=d_id, recipient=r_id, error=str(e)[:120],
+            )
             for node in (d_id, r_id):
                 try:
                     self._rpc(node, {"op": "migrate_abort", "mid": mid})

@@ -30,6 +30,7 @@ import logging
 from typing import Callable, Dict, Optional
 
 from parameter_server_tpu.config import TableConfig
+from parameter_server_tpu.core import flightrec
 from parameter_server_tpu.core.postoffice import Postoffice
 from parameter_server_tpu.core.van import Van
 from parameter_server_tpu.kv.routing import RoutingTable
@@ -118,6 +119,9 @@ def promote(van: Van, standby: KVServer, primary_id: str) -> KVServer:
     reconnect = getattr(van, "reconnect", None)
     if reconnect is not None:
         reconnect(primary_id)
+    flightrec.record(
+        "node.promote", node=primary_id, standby=old_id,
+    )
     return standby
 
 
@@ -213,6 +217,9 @@ def restart_same_id(
             van.drop_inbound_state(primary_id)
     logging.getLogger(__name__).info(
         "restart_same_id: %s restored from %s", primary_id, source
+    )
+    flightrec.record(
+        "node.restart", node=primary_id, source=source,
     )
     for nid in (primary_id, f"{primary_id}.fw", f"{primary_id}.mig"):
         reconnect = getattr(van, "reconnect", None)

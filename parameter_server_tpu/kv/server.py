@@ -37,6 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from parameter_server_tpu.config import TableConfig
+from parameter_server_tpu.core import flightrec
 from parameter_server_tpu.core.messages import Message, Task, TaskKind
 from parameter_server_tpu.core.postoffice import Customer, Postoffice
 from parameter_server_tpu.kv.partition import RangePartition
@@ -209,6 +210,10 @@ class KVServer(Customer):
         than what it holds — rejected, not lost.
         """
         self.fenced_rejects += 1
+        flightrec.record(
+            "fence.routing", node=self.post.node_id, sender=msg.sender,
+            epoch=self.routing.epoch, why=why[:120],
+        )
         reply = msg.reply()
         reply.task = dataclasses.replace(
             msg.task,
@@ -587,6 +592,10 @@ class KVServer(Customer):
             self._migrations[mid] = {
                 "table": t, "lo": lo, "hi": hi, "dirty": set()
             }
+            flightrec.record(
+                "migrate.begin", node=self.post.node_id, mid=mid,
+                table=t, lo=lo, hi=hi,
+            )
             return msg.reply()
         if op == "migrate_send":
             # donor: stream one live chunk to the recipient, keep serving
@@ -594,6 +603,10 @@ class KVServer(Customer):
             # per-chunk pause, not the whole transfer)
             m = self._migrations[p["mid"]]
             lo, hi = int(p["lo"]), int(p["hi"])
+            flightrec.record(
+                "migrate.send", node=self.post.node_id, mid=p["mid"],
+                to=p["to"], lo=lo, hi=hi,
+            )
             value, state = self.export_range(m["table"], lo, hi)
             skeys = sorted(state)
             self._mig_rpc(
@@ -620,6 +633,10 @@ class KVServer(Customer):
                 for k, v in zip(p["state_keys"], msg.values[1:])
             }
             st["chunks"].append((int(p["lo"]), int(p["hi"]), value, state))
+            flightrec.record(
+                "migrate.stage", node=self.post.node_id, mid=p["mid"],
+                lo=int(p["lo"]), hi=int(p["hi"]),
+            )
             return msg.reply()
         if op == "migrate_commit":
             return self._commit_migration(msg)
@@ -640,14 +657,24 @@ class KVServer(Customer):
                 routing, extra={p["table"]: (gids, value, state)}
             )
             self.rows_migrated_in += int(gids.size)
+            flightrec.record(
+                "migrate.adopt", node=self.post.node_id,
+                table=p["table"], rows=int(gids.size),
+            )
             return msg.reply()
         if op == "migrate_release":
             # donor's standby: drop the moved range, mirroring the primary
             self._install_routing(RoutingTable.from_payload(p["routing"]))
+            flightrec.record(
+                "migrate.release", node=self.post.node_id, table=p["table"],
+            )
             return msg.reply()
         if op == "migrate_abort":
             self._migrations.pop(p["mid"], None)
             self._staging.pop(p["mid"], None)
+            flightrec.record(
+                "migrate.abort", node=self.post.node_id, mid=p["mid"],
+            )
             return msg.reply()
         raise ValueError(f"unsupported migration op {op!r}")
 
@@ -705,6 +732,11 @@ class KVServer(Customer):
         freeze = time.perf_counter() - t0
         self.migration_freeze_last_s = freeze
         self.migration_freeze_s += freeze
+        flightrec.record(
+            "migrate.commit", node=self.post.node_id, mid=p["mid"],
+            table=t, rows=m["hi"] - m["lo"], dirty=int(dirty.size),
+            epoch=new_routing.epoch, freeze_ms=round(1e3 * freeze, 3),
+        )
         return msg.reply(values=[np.asarray([freeze], np.float64)])
 
     def _install_migration(self, msg: Message) -> Message:
@@ -743,6 +775,10 @@ class KVServer(Customer):
         gids = np.arange(lo, hi, dtype=np.int64)
         self._install_routing(routing, extra={t: (gids, value, state)})
         self.rows_migrated_in += n
+        flightrec.record(
+            "migrate.install", node=self.post.node_id, mid=p["mid"],
+            table=t, lo=lo, hi=hi, epoch=routing.epoch,
+        )
         if self.replica is not None:
             self._forward_control(
                 {

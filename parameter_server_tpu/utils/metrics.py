@@ -78,7 +78,9 @@ class CounterGroup:
     :class:`~parameter_server_tpu.kv.migrate.ShardMigrator` (moves/aborts).
     Group them (``CounterGroup(*servers, *workers, migrator)``) and attach
     as ``Dashboard(migration=...)`` so a rebalance shows up in the SAME rows
-    as retransmits and cancels.
+    as retransmits and cancels.  Postoffices also expose ``counters()``
+    (``cancelled_drops``) — include them in the group and the Dashboard's
+    transport ``rejects`` sub-dict lights up cancellation fences too.
     """
 
     def __init__(self, *sources) -> None:
@@ -312,6 +314,22 @@ class Dashboard:
                     row["migration"] = mig_counters()
                 except Exception:  # pragma: no cover — metrics must never
                     pass  # crash training
+        net_row = row.get("net")
+        if net_row is not None:
+            # every reject class in one 0-filled sub-dict, so a garbled-wire
+            # or fencing storm is visible in the transport section without
+            # grepping per-layer counters.  frame/CRC/incarnation rejects
+            # come from the van walk; routing fences and cancellation drops
+            # live on KVServers / Postoffices — attach them via the
+            # ``migration`` CounterGroup to light those two up.
+            mig_row = row.get("migration") or {}
+            net_row["rejects"] = {
+                "frame_rejects": int(net_row.get("frame_rejects", 0)),
+                "rejected_corrupt": int(net_row.get("rejected_corrupt", 0)),
+                "rejected_stale": int(net_row.get("rejected_stale", 0)),
+                "fenced_rejects": int(mig_row.get("fenced_rejects", 0)),
+                "cancelled_drops": int(mig_row.get("cancelled_drops", 0)),
+            }
         printing = self.print_every and iteration % self.print_every == 0
         if self.tracer is not None and (printing or self.jsonl is not None):
             # interval DELTAS (this row's share), from the tracer's O(1)

@@ -1,0 +1,273 @@
+"""Declarative SLOs over the observability plane's existing series.
+
+ROADMAP's read-heavy serving plane needs breach detection before admission
+control / load shedding can land; this module is that substrate.  An
+:class:`SloSpec` names a metric, a ceiling, and how to read the samples
+(instantaneous gauge, windowed rate of a cumulative counter, or windowed
+p99 of a cumulative :class:`~parameter_server_tpu.utils.trace.LatencyHistogram`
+digest); an :class:`SloEngine` holds per-(node, metric) rolling windows fed
+from the series the plane already produces — FleetMonitor snapshot rows,
+``transport_counters`` dicts, MeteredVan per-link digests — and turns them
+into per-node health verdicts.
+
+Breaches are edge-triggered into the flight recorder: ``slo.breach`` when a
+spec first exceeds its ceiling on a node, ``slo.clear`` when it recovers —
+so the postmortem timeline shows WHEN health flipped, not a line per sweep.
+The verdict objects themselves are level-triggered (current truth), which
+is what an admission controller will poll.
+
+Examples::
+
+    specs = [
+        SloSpec("inbound-p99", "push_p99_ms", 50.0),            # gauge
+        SloSpec("retransmit-rate", "retransmits", 10.0,
+                source="rate", window_s=5.0),                    # per-second
+        SloSpec("bytes-per-step", "wire_bytes_per_step", 2e6),   # gauge
+    ]
+    eng = SloEngine(specs)
+    eng.ingest_fleet(fleet)               # each monitor sweep
+    eng.ingest_counters("S1", transport_counters(van))
+    verdicts = eng.evaluate()             # {node: SloVerdict}
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import time
+from typing import Deque, Dict, List, Optional, Tuple
+
+from parameter_server_tpu.core import flightrec
+from parameter_server_tpu.utils.trace import LatencyHistogram
+
+_SOURCES = ("gauge", "rate", "p99")
+
+
+@dataclasses.dataclass(frozen=True)
+class SloSpec:
+    """One service-level objective: ``metric`` must stay <= ``max_value``.
+
+    ``source`` picks the sample semantics:
+
+    - ``"gauge"``: latest observed value inside the window (snapshot rows
+      like ``push_p99_ms`` are already derived — compare directly);
+    - ``"rate"``: (last - first) / elapsed over the window, for CUMULATIVE
+      counters (``retransmits``, ``wire_bytes``) — ``max_value`` is per
+      second;
+    - ``"p99"``: windowed p99 in MILLISECONDS of a cumulative
+      LatencyHistogram digest series — the window's delta histogram is
+      reconstructed by differencing bucket counts, so the p99 covers only
+      samples recorded inside the window, not the whole run.
+    """
+
+    name: str
+    metric: str
+    max_value: float
+    source: str = "gauge"
+    window_s: float = 10.0
+    min_samples: int = 1
+
+    def __post_init__(self) -> None:
+        if self.source not in _SOURCES:
+            raise ValueError(
+                f"SloSpec {self.name!r}: source must be one of {_SOURCES}, "
+                f"got {self.source!r}"
+            )
+        if self.window_s <= 0:
+            raise ValueError(f"SloSpec {self.name!r}: window_s must be > 0")
+
+
+@dataclasses.dataclass
+class SloVerdict:
+    """Per-node health verdict from one :meth:`SloEngine.evaluate` sweep."""
+
+    node: str
+    healthy: bool
+    #: spec name -> (observed value, ceiling) for every breached spec.
+    breaches: Dict[str, Tuple[float, float]]
+    #: spec name -> observed value for every spec that had enough samples.
+    observed: Dict[str, float]
+
+
+class SloEngine:
+    """Rolling-window evaluator for a set of :class:`SloSpec` objects.
+
+    Feed it with any mix of :meth:`observe` (raw samples),
+    :meth:`ingest_fleet` (FleetMonitor snapshot rows + per-link deliver
+    digests), and :meth:`ingest_counters` (cumulative counter dicts);
+    :meth:`evaluate` computes windowed values per node and edge-triggers
+    ``slo.breach`` / ``slo.clear`` flight-recorder events on transitions.
+    """
+
+    def __init__(
+        self,
+        specs: List[SloSpec],
+        *,
+        recorder: Optional[flightrec.FlightRecorder] = None,
+    ) -> None:
+        names = [s.name for s in specs]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate SloSpec names: {sorted(names)}")
+        self.specs = list(specs)
+        self._recorder = recorder
+        #: (node, metric) -> deque of (t, value-or-digest-dict) samples.
+        self._series: Dict[Tuple[str, str], Deque[Tuple[float, object]]] = {}
+        #: (spec name, node) -> currently breached?  (edge-trigger state)
+        self._breached: Dict[Tuple[str, str], bool] = {}
+        self._nodes: set = set()
+
+    # -- ingest --------------------------------------------------------------
+    def observe(
+        self, node: str, metric: str, value, now: Optional[float] = None
+    ) -> None:
+        """Record one sample.  ``value`` is a number for gauge/rate metrics
+        or a LatencyHistogram digest dict (``to_dict`` form) for p99 ones."""
+        now = time.monotonic() if now is None else now
+        self._nodes.add(node)
+        key = (node, metric)
+        dq = self._series.get(key)
+        if dq is None:
+            dq = self._series[key] = collections.deque(maxlen=1024)
+        dq.append((now, value))
+
+    def ingest_fleet(self, fleet, now: Optional[float] = None) -> None:
+        """Sample every numeric field of each FleetMonitor snapshot row,
+        plus each node's cumulative inbound deliver digest (for ``p99``
+        specs over ``inbound_deliver``)."""
+        now = time.monotonic() if now is None else now
+        for node, row in fleet.snapshot(now).items():
+            for metric, value in row.items():
+                if isinstance(value, (int, float)):
+                    self.observe(node, metric, float(value), now)
+        wants_inbound = any(
+            s.source == "p99" and s.metric == "inbound_deliver"
+            for s in self.specs
+        )
+        if wants_inbound:
+            with fleet._lock:
+                links = dict(fleet._links)
+            for node in fleet.nodes():
+                h = fleet._inbound_hist(links, node)
+                if h.count:
+                    self.observe(node, "inbound_deliver", h.to_dict(), now)
+
+    def ingest_counters(
+        self, node: str, counters: dict, now: Optional[float] = None
+    ) -> None:
+        """Sample a cumulative counter dict (``transport_counters`` output,
+        a server's ``counters()``) for ``rate`` and ``gauge`` specs."""
+        now = time.monotonic() if now is None else now
+        for metric, value in counters.items():
+            if isinstance(value, (int, float)):
+                self.observe(node, metric, float(value), now)
+
+    # -- evaluation ----------------------------------------------------------
+    def _windowed(
+        self, spec: SloSpec, node: str, now: float
+    ) -> Optional[float]:
+        """Current value of ``spec`` on ``node``, or None without enough
+        in-window samples."""
+        dq = self._series.get((node, spec.metric))
+        if not dq:
+            return None
+        cutoff = now - spec.window_s
+        window = [(t, v) for t, v in dq if t >= cutoff]
+        if len(window) < spec.min_samples:
+            return None
+        if spec.source == "gauge":
+            return float(window[-1][1])
+        if spec.source == "rate":
+            if len(window) < 2:
+                return None
+            (t0, v0), (t1, v1) = window[0], window[-1]
+            if t1 <= t0:
+                return None
+            return (float(v1) - float(v0)) / (t1 - t0)
+        # p99 over the window's delta histogram
+        if len(window) < 2:
+            return None
+        first, last = window[0][1], window[-1][1]
+        delta = _delta_hist(first, last)
+        if delta.count < spec.min_samples:
+            return None
+        return 1e3 * delta.percentile(0.99)
+
+    def evaluate(self, now: Optional[float] = None) -> Dict[str, SloVerdict]:
+        """Per-node verdicts; edge-triggers breach/clear recorder events."""
+        now = time.monotonic() if now is None else now
+        # explicit None test: an EMPTY FlightRecorder is falsy (__len__ == 0),
+        # and the first breach is exactly when the injected recorder is empty
+        rec = (
+            flightrec.record if self._recorder is None
+            else self._recorder.record
+        )
+        out: Dict[str, SloVerdict] = {}
+        for node in sorted(self._nodes):
+            breaches: Dict[str, Tuple[float, float]] = {}
+            observed: Dict[str, float] = {}
+            for spec in self.specs:
+                value = self._windowed(spec, node, now)
+                if value is None:
+                    continue
+                observed[spec.name] = value
+                key = (spec.name, node)
+                was = self._breached.get(key, False)
+                is_breach = value > spec.max_value
+                if is_breach:
+                    breaches[spec.name] = (value, spec.max_value)
+                if is_breach and not was:
+                    rec(
+                        "slo.breach",
+                        node=node,
+                        slo=spec.name,
+                        metric=spec.metric,
+                        value=round(value, 4),
+                        limit=spec.max_value,
+                    )
+                elif was and not is_breach:
+                    rec(
+                        "slo.clear",
+                        node=node,
+                        slo=spec.name,
+                        metric=spec.metric,
+                        value=round(value, 4),
+                        limit=spec.max_value,
+                    )
+                self._breached[key] = is_breach
+            out[node] = SloVerdict(
+                node=node,
+                healthy=not breaches,
+                breaches=breaches,
+                observed=observed,
+            )
+        return out
+
+    def healthy(self, node: str) -> bool:
+        """Level-triggered health of one node per the LAST evaluate sweep —
+        the poll the future serving plane's admission control consumes."""
+        return not any(
+            breached and name_node[1] == node
+            for name_node, breached in self._breached.items()
+        )
+
+
+def _delta_hist(first: dict, last: dict) -> LatencyHistogram:
+    """Histogram of the samples recorded BETWEEN two cumulative digests.
+
+    Differences sparse bucket counts; count/sum difference likewise.  A
+    negative difference (recorder reset between samples) falls back to the
+    later digest alone rather than inventing negative mass.
+    """
+    h_last = LatencyHistogram.from_dict(last)
+    h_first = LatencyHistogram.from_dict(first)
+    if h_last.count < h_first.count:
+        return h_last
+    delta = LatencyHistogram()
+    for i in range(delta.NBUCKETS):
+        delta.counts[i] = h_last.counts[i] - h_first.counts[i]
+        if delta.counts[i] < 0:
+            return h_last
+    delta.count = h_last.count - h_first.count
+    delta.sum_s = max(h_last.sum_s - h_first.sum_s, 0.0)
+    delta.max_s = h_last.max_s  # upper bound: exact window max not tracked
+    return delta

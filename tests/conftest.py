@@ -73,3 +73,26 @@ def pytest_runtest_makereport(item, call):
     report.sections.append(
         ("chaos repro", f"{detail}\nrepro: {repro}")
     )
+    # Black-box postmortem: the process-wide flight recorder still holds the
+    # last N transport/KV events of the failed scenario — capture them before
+    # the next test overwrites the ring.  Best-effort: a broken recorder must
+    # not turn one failure into two.
+    try:
+        import pathlib
+        import re
+
+        from parameter_server_tpu.core import flightrec
+
+        slug = re.sub(r"[^A-Za-z0-9_.-]+", "_", item.nodeid)[-80:]
+        out_dir = pathlib.Path("/tmp/ps_postmortem") / slug
+        paths = flightrec.dump(str(out_dir), reason=f"test-failure:{item.nodeid}")
+        if paths:
+            report.sections.append(
+                (
+                    "postmortem bundle",
+                    "\n".join(paths)
+                    + f"\nmerge: python tools/postmortem.py {out_dir}/*.json",
+                )
+            )
+    except Exception:
+        pass

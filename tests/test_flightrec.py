@@ -1,0 +1,499 @@
+"""Flight recorder + SLO plane (ISSUE 8 tentpole).
+
+Acceptance anchors:
+
+1. a seeded chaos run that kills a server mid-migration produces a
+   postmortem bundle from which ``tools/postmortem.py`` reconstructs the
+   fence -> retransmit -> restart sequence in causal order across nodes;
+2. an ``SloSpec`` on inbound p99 fires exactly while ``ChaosVan.slow_node``
+   is active on one server, and never on the clean run;
+3. unit coverage: ring bounds, per-node bundle split, JSONL rotation with
+   the no-truncated-last-line guarantee, Dashboard rejects sub-dict, and
+   the ``LatencyHistogram.percentile`` edge cases (ISSUE 8 satellite).
+"""
+
+import json
+import pathlib
+import sys
+
+import numpy as np
+import pytest
+
+from parameter_server_tpu.config import OptimizerConfig, TableConfig
+from parameter_server_tpu.core import flightrec
+from parameter_server_tpu.core.chaos import ChaosVan
+from parameter_server_tpu.core.fleet import (
+    FleetMonitor,
+    RotatingJsonlWriter,
+    StragglerPolicy,
+)
+from parameter_server_tpu.core.manager import SCHEDULER, launch_local_cluster
+from parameter_server_tpu.core.messages import server_id, worker_id
+from parameter_server_tpu.core.netmon import MeteredVan
+from parameter_server_tpu.core.postoffice import Postoffice
+from parameter_server_tpu.core.resender import ReliableVan
+from parameter_server_tpu.core.van import LoopbackVan
+from parameter_server_tpu.kv import replica as replica_lib
+from parameter_server_tpu.kv.migrate import ShardMigrator
+from parameter_server_tpu.kv.worker import KVWorker
+from parameter_server_tpu.utils.slo import SloEngine, SloSpec
+from parameter_server_tpu.utils.trace import LatencyHistogram
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+import postmortem  # noqa: E402
+
+ROWS = 1 << 10
+NUM_SERVERS = 2
+
+
+def _table_cfgs():
+    return {
+        "w": TableConfig(
+            name="w", rows=ROWS, dim=2,
+            optimizer=OptimizerConfig(kind="sgd", learning_rate=0.1),
+        )
+    }
+
+
+# ------------------------------------------------------------- ring basics
+
+
+def test_ring_is_bounded_and_ordered():
+    rec = flightrec.FlightRecorder(capacity=16)
+    for i in range(40):
+        rec.record("frame.send", node="A", i=i)
+    assert len(rec) == 16
+    evs = rec.events()
+    assert [e["i"] for e in evs] == list(range(24, 40))  # oldest evicted
+    assert [e["seq"] for e in evs] == sorted(e["seq"] for e in evs)
+    t = [e["t_mono_s"] for e in evs]
+    assert t == sorted(t)
+
+
+def test_disabled_recorder_records_nothing():
+    rec = flightrec.FlightRecorder(capacity=16, enabled=False)
+    rec.record("frame.send", node="A")
+    assert len(rec) == 0
+
+
+def test_configure_resizes_preserving_tail():
+    flightrec.configure(clear=True)
+    for i in range(10):
+        flightrec.record("frame.send", node="A", i=i)
+    rec = flightrec.configure(capacity=4)
+    assert [e["i"] for e in rec.events()] == [6, 7, 8, 9]
+    flightrec.configure(capacity=4096, clear=True)
+
+
+# ------------------------------------------------------------ bundle dumps
+
+
+def test_dump_splits_events_per_node(tmp_path):
+    rec = flightrec.FlightRecorder(capacity=64)
+    rec.record("frame.send", node="S0", bytes=10)
+    rec.record("frame.recv", node="W0", sender="S0")
+    rec.record("slo.breach")  # no node field -> _process bundle
+    paths = rec.dump(str(tmp_path), reason="unit")
+    names = {pathlib.Path(p).name for p in paths}
+    assert names == {
+        "flightrec__process.json",
+        "flightrec_S0.json",
+        "flightrec_W0.json",
+    }
+    s0 = json.loads((tmp_path / "flightrec_S0.json").read_text())
+    assert s0["node"] == "S0" and s0["reason"] == "unit"
+    assert [e["kind"] for e in s0["events"]] == ["frame.send"]
+    assert s0["wall_anchor_s"] > 0 and "mono_anchor_s" in s0
+    proc = json.loads((tmp_path / "flightrec__process.json").read_text())
+    # the dump marker itself is journaled into the node-less bundle
+    assert [e["kind"] for e in proc["events"]] == [
+        "slo.breach", "postmortem.dump",
+    ]
+
+
+def test_dump_walks_van_counters(tmp_path):
+    van = MeteredVan(LoopbackVan())
+    try:
+        rec = flightrec.FlightRecorder()
+        rec.record("frame.send", node="A")
+        paths = rec.dump(str(tmp_path), van=van)
+        doc = json.loads(pathlib.Path(paths[0]).read_text())
+        assert "sent" in doc["counters"]  # LoopbackVan layer reached
+        assert doc["histograms"] == {}  # MeteredVan links(), no traffic yet
+    finally:
+        van.close()
+
+
+# --------------------------------------------- JSONL rotation (satellite 2)
+
+
+def test_rotating_jsonl_writer_never_truncates(tmp_path):
+    path = tmp_path / "fleet.jsonl"
+    w = RotatingJsonlWriter(str(path), rotate_bytes=200)
+    for i in range(50):
+        w.write_line(json.dumps({"beat": i, "pad": "x" * 20}))
+    w.sync()
+    assert w.rotations > 0
+    rows = []
+    for f in sorted(tmp_path.glob("fleet.jsonl*")):
+        for line in f.read_text().splitlines():
+            rows.append(json.loads(line))  # every line parses — no torn tail
+        assert f.stat().st_size <= 200 + 40  # one line of slack max
+    assert sorted(r["beat"] for r in rows) == list(range(50))
+    w.close()
+
+
+def test_fleet_monitor_rotated_sink_and_flush(tmp_path):
+    path = tmp_path / "fleet.jsonl"
+    fleet = FleetMonitor(jsonl_path=str(path), rotate_bytes=4096)
+    fleet.observe("A", {}, now=1.0)
+    fleet.write_jsonl(now=1.0)
+    fleet.flush_jsonl()
+    rows = [json.loads(x) for x in path.read_text().splitlines()]
+    assert rows and "A" in rows[-1]["nodes"]
+    with pytest.raises(ValueError):
+        FleetMonitor(jsonl=sys.stdout, jsonl_path=str(path))
+
+
+# ------------------------------------- Dashboard rejects dict (satellite 1)
+
+
+def test_dashboard_surfaces_reject_counters():
+    import io
+
+    from parameter_server_tpu.utils import metrics as metrics_lib
+
+    class _Wire:
+        def counters(self):
+            return {
+                "sent": 10, "frame_rejects": 2,
+                "rejected_corrupt": 1, "rejected_stale": 3,
+            }
+
+    class _Mig:
+        def counters(self):
+            return {"fenced_rejects": 4, "cancelled_drops": 5}
+
+    sink = io.StringIO()
+    dash = metrics_lib.Dashboard(
+        jsonl=sink, print_every=0, transport=_Wire(), migration=_Mig()
+    )
+    dash.record(1, 0.5, examples=10)
+    row = json.loads(sink.getvalue().splitlines()[0])
+    assert row["net"]["rejects"] == {
+        "frame_rejects": 2, "rejected_corrupt": 1, "rejected_stale": 3,
+        "fenced_rejects": 4, "cancelled_drops": 5,
+    }
+
+
+def test_postoffice_counters_carry_cancelled_drops():
+    van = LoopbackVan()
+    try:
+        post = Postoffice("A", van)
+        assert post.counters() == {"cancelled_drops": 0}
+    finally:
+        van.close()
+
+
+# ------------------------- LatencyHistogram.percentile edges (satellite 3)
+
+
+def test_percentile_empty_histogram_is_zero():
+    assert LatencyHistogram().percentile(0.99) == 0.0
+
+
+def test_percentile_single_sample_within_bucket():
+    h = LatencyHistogram()
+    h.record(0.010)
+    for p in (0.0, 0.5, 0.99, 1.0):
+        v = h.percentile(p)
+        assert 0.010 / h.GROWTH <= v <= 0.010 * h.GROWTH
+
+
+def test_percentile_merge_disjoint_ranges():
+    lo, hi = LatencyHistogram(), LatencyHistogram()
+    for _ in range(99):
+        lo.record(1e-4)  # 0.1 ms cluster
+    hi.record(0.5)       # one 500 ms outlier
+    merged = lo.merge(hi)
+    assert merged.count == 100
+    # p50 stays in the low cluster; p100 lands on the outlier (capped at max)
+    assert merged.percentile(0.5) < 1e-3
+    assert merged.percentile(1.0) == pytest.approx(0.5, rel=0.25)
+    assert merged.max_s == 0.5
+
+
+def test_percentile_within_one_bucket_of_exact():
+    """25%-growth geometric buckets: p99 must land within one bucket edge
+    (<= GROWTH relative error) of the exact sample p99 on synthetic data."""
+    rng = np.random.default_rng(7)
+    samples = np.abs(rng.lognormal(mean=-6.0, sigma=1.0, size=5000))
+    h = LatencyHistogram()
+    for s in samples:
+        h.record(float(s))
+    exact = float(np.quantile(samples, 0.99))
+    approx = h.percentile(0.99)
+    g = h.GROWTH
+    assert exact / g <= approx <= exact * g, (
+        f"p99 {approx} vs exact {exact}: off by more than one bucket"
+    )
+
+
+# ----------------------------------------------------- SLO engine (unit)
+
+
+def test_slo_gauge_breach_and_clear_edge_triggered():
+    rec = flightrec.FlightRecorder(capacity=64)
+    eng = SloEngine(
+        [SloSpec("p99", "push_p99_ms", 50.0, window_s=100.0)], recorder=rec
+    )
+    eng.observe("S1", "push_p99_ms", 10.0, now=1.0)
+    assert eng.evaluate(now=1.0)["S1"].healthy
+    eng.observe("S1", "push_p99_ms", 80.0, now=2.0)
+    v = eng.evaluate(now=2.0)["S1"]
+    assert not v.healthy and v.breaches["p99"] == (80.0, 50.0)
+    assert not eng.healthy("S1")
+    eng.evaluate(now=2.5)  # still breached: NO second breach event
+    eng.observe("S1", "push_p99_ms", 5.0, now=3.0)
+    assert eng.evaluate(now=3.0)["S1"].healthy
+    kinds = [e["kind"] for e in rec.events()]
+    assert kinds == ["slo.breach", "slo.clear"]
+
+
+def test_slo_rate_spec_on_cumulative_counter():
+    eng = SloEngine(
+        [SloSpec("rtx", "retransmits", 10.0, source="rate", window_s=100.0)]
+    )
+    eng.ingest_counters("S0", {"retransmits": 0}, now=0.0)
+    eng.ingest_counters("S0", {"retransmits": 50}, now=2.0)  # 25/s
+    v = eng.evaluate(now=2.0)["S0"]
+    assert v.breaches["rtx"][0] == pytest.approx(25.0)
+    eng2 = SloEngine(
+        [SloSpec("rtx", "retransmits", 30.0, source="rate", window_s=100.0)]
+    )
+    eng2.ingest_counters("S0", {"retransmits": 0}, now=0.0)
+    eng2.ingest_counters("S0", {"retransmits": 50}, now=2.0)
+    assert eng2.evaluate(now=2.0)["S0"].healthy
+
+
+def test_slo_spec_validation():
+    with pytest.raises(ValueError):
+        SloSpec("x", "m", 1.0, source="median")
+    with pytest.raises(ValueError):
+        SloSpec("x", "m", 1.0, window_s=0.0)
+    with pytest.raises(ValueError):
+        SloEngine([SloSpec("a", "m", 1.0), SloSpec("a", "n", 1.0)])
+
+
+# ---------------------------------------- acceptance 1: donor-kill bundle
+
+
+@pytest.mark.chaos
+@pytest.mark.migration
+def test_postmortem_reconstructs_donor_kill_in_causal_order(tmp_path):
+    """Seeded chaos kills the donor mid-migration; the dumped bundles merge
+    into one timeline where partial-migration -> restart -> re-run commit ->
+    stale-routing fence appear in causal order, with the chaos-driven
+    retransmits interleaved."""
+    flightrec.configure(clear=True)
+    chaos = ChaosVan(LoopbackVan(), seed=0, drop=0.05)
+    van = ReliableVan(chaos, timeout=0.1, backoff=1.0, max_retries=60, seed=0)
+    try:
+        cfgs = _table_cfgs()
+        primaries, standbys = replica_lib.make_replicated_servers(
+            van, cfgs, NUM_SERVERS, sync=True
+        )
+        worker = KVWorker(Postoffice("W0", van), cfgs, NUM_SERVERS)
+        mig = ShardMigrator(Postoffice("M0", van), chunk_rows=64)
+        rng = np.random.default_rng(0)
+
+        def push_round():
+            keys = rng.integers(0, ROWS, size=64).astype(np.uint64)
+            grads = rng.standard_normal((64, 2)).astype(np.float32)
+            worker.push_sync("w", keys, grads, timeout=60)
+
+        for _ in range(4):  # chaos drops here force retransmits
+            push_round()
+
+        stale_routing = worker.routing
+        mid = "test:kill:0"
+        mig._rpc("S1", {"op": "migrate_begin", "mid": mid, "table": "w",
+                        "lo": 768, "hi": ROWS})
+        mig._rpc("S1", {"op": "migrate_send", "mid": mid, "to": "S0",
+                        "lo": 768, "hi": 832})
+        for endpoint in ("S1", "S1.fw", "S1.mig"):
+            van.unbind(endpoint)
+        van.restart_node("S1")
+        new_s1, source = replica_lib.restart_same_id(
+            van, cfgs, 1, NUM_SERVERS, standby=standbys[1]
+        )
+        assert source == "replica"
+        new_routing = mig.migrate(stale_routing, "w", 768, ROWS, 0)
+
+        # worker still routes by the PRE-migration table: this push lands on
+        # the restarted donor, which fences it (typed reject + new table);
+        # the worker adopts and resubmits transparently
+        keys = np.arange(800, 864, dtype=np.uint64)
+        grads = np.ones((64, 2), np.float32)
+        worker.push_sync("w", keys, grads, timeout=60)
+        assert worker.routing.epoch == new_routing.epoch
+        assert van.flush(10)
+        assert chaos.injected_drops > 0
+
+        paths = flightrec.dump(str(tmp_path), van=van, reason="donor-kill")
+        merged = postmortem.merge_bundles(paths)
+        events = merged["events"]
+        t = [e["t_s"] for e in events]
+        assert t == sorted(t)  # causal: rebased time is nondecreasing
+        assert set(merged["nodes"]) >= {"S0", "S1", "W0"}
+        assert "retransmits" in merged["counters"]["S1"]
+
+        def first(kind, after=-1, **match):
+            for i, e in enumerate(events):
+                if i > after and e["kind"] == kind and all(
+                    e.get(k) == v for k, v in match.items()
+                ):
+                    return i
+            raise AssertionError(
+                f"no {kind} {match} after index {after}; kinds="
+                f"{[e['kind'] for e in events]}"
+            )
+
+        i_begin = first("migrate.begin", mid=mid)
+        i_stage = first("migrate.stage", after=i_begin)
+        i_restart = first("node.restart", node="S1", source="replica")
+        i_commit = first("migrate.commit", after=i_restart, node="S1")
+        i_install = first("migrate.install", after=i_restart, node="S0")
+        i_fence = first("fence.routing", after=i_commit, node="S1")
+        assert i_begin < i_stage < i_restart < i_commit < i_fence
+        assert i_install > i_restart
+        assert any(e["kind"] == "resend.retransmit" for e in events)
+
+        # the CLI report anchors on the first anomaly of the story
+        anom = postmortem.first_anomaly(events)
+        assert anom is not None and events[anom]["kind"] in (
+            postmortem.ANOMALY_KINDS
+        )
+        lines = postmortem.report(merged, last=20)
+        assert any("first anomaly" in ln for ln in lines)
+        assert any("node.restart" in ln for ln in lines)
+        # tool and library agree on what "anomaly" means
+        assert postmortem.ANOMALY_KINDS == flightrec.anomaly_kinds()
+    finally:
+        van.close()
+        flightrec.configure(clear=True)
+
+
+# ------------------------------------------- acceptance 2: SLO vs slow_node
+
+
+@pytest.mark.chaos
+def test_slo_fires_exactly_under_slow_node_and_never_clean():
+    """Full Metered(Reliable(Chaos(Loopback))) stack: the inbound-p99 spec
+    stays green across the whole clean phase, then breaches on (exactly)
+    the slowed server once ``slow_node`` is active."""
+    chaos = ChaosVan(LoopbackVan(), seed=0)
+    reliable = ReliableVan(
+        chaos, timeout=5.0, backoff=1.0, max_retries=3, seed=0
+    )
+    van = MeteredVan(reliable)
+    rec = flightrec.FlightRecorder(capacity=256)
+    try:
+        sched, managers, posts = launch_local_cluster(
+            van, num_workers=2, num_servers=2
+        )
+        fleet = FleetMonitor(policy=StragglerPolicy(k=4.0, p99_floor_ms=40.0))
+        sched.fleet = fleet
+        cfgs = _table_cfgs()
+        from parameter_server_tpu.kv.server import KVServer
+
+        servers = [
+            KVServer(posts[server_id(s)], cfgs, s, 2) for s in range(2)
+        ]
+        workers = [
+            KVWorker(posts[worker_id(w)], cfgs, 2, min_bucket=16)
+            for w in range(2)
+        ]
+        eng = SloEngine(
+            [SloSpec("inbound-p99", "push_p99_ms", 40.0, window_s=120.0)],
+            recorder=rec,
+        )
+        rng = np.random.default_rng(1)
+
+        def beat():
+            for w in workers:
+                keys = rng.integers(0, ROWS, size=48).astype(np.uint64)
+                grads = rng.standard_normal((48, 2)).astype(np.float32)
+                assert w.wait(w.push("w", keys, grads), timeout=60)
+            for nid, mgr in managers.items():
+                if nid != SCHEDULER:
+                    assert mgr.wait(mgr.send_heartbeat(), timeout=60)
+            eng.ingest_fleet(fleet)
+            return eng.evaluate()
+
+        for _ in range(3):  # clean phase: loopback ~us latencies
+            verdicts = beat()
+            assert all(v.healthy for v in verdicts.values()), verdicts
+        assert [e["kind"] for e in rec.events()] == []
+
+        chaos.slow_node(server_id(1), 120.0)  # the gray failure
+        breached = set()
+        for _ in range(1, 6):
+            verdicts = beat()
+            breached |= {n for n, v in verdicts.items() if not v.healthy}
+        assert breached == {server_id(1)}, (
+            f"expected exactly S1 to breach, got {breached}; "
+            f"snapshot={fleet.snapshot()}"
+        )
+        assert not eng.healthy(server_id(1))
+        assert all(
+            eng.healthy(n) for n in verdicts if n != server_id(1)
+        )
+        breaches = [e for e in rec.events() if e["kind"] == "slo.breach"]
+        assert len(breaches) == 1  # edge-triggered, not once per sweep
+        assert breaches[0]["node"] == server_id(1)
+        assert breaches[0]["slo"] == "inbound-p99"
+        assert chaos.injected_slow > 0
+        del servers
+    finally:
+        van.close()
+
+
+# ----------------------------------------------- recv-exception trigger
+
+
+def test_recv_exception_journals_and_autodumps(tmp_path, monkeypatch):
+    monkeypatch.setenv(flightrec.DUMP_DIR_ENV, str(tmp_path / "auto"))
+    flightrec.configure(clear=True)
+    van = LoopbackVan()
+    try:
+        def bad_handler(msg):
+            raise RuntimeError("boom in handler")
+
+        van.bind("X", bad_handler)
+        from parameter_server_tpu.core.messages import Message, Task, TaskKind
+
+        van.send(Message(
+            sender="Y", recver="X",
+            task=Task(kind=TaskKind.CONTROL, customer="c", time=0),
+        ))
+        deadline = __import__("time").time() + 5
+        while __import__("time").time() < deadline:
+            if any(
+                e["kind"] == "recv.exception" for e in flightrec.get().events()
+            ):
+                break
+            __import__("time").sleep(0.01)
+        evs = [
+            e for e in flightrec.get().events()
+            if e["kind"] == "recv.exception"
+        ]
+        assert evs and evs[0]["node"] == "X"
+        assert "boom in handler" in evs[0]["exc"]
+        bundles = list((tmp_path / "auto").glob("flightrec_*.json"))
+        assert bundles  # env-triggered auto-dump captured the ring
+    finally:
+        van.close()
+        flightrec.configure(clear=True)

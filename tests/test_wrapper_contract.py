@@ -104,6 +104,108 @@ def test_main_fails_loudly_if_hot_path_module_missing(tmp_path, monkeypatch):
     assert check_wrappers.main(["check_wrappers"]) == 1
 
 
+def test_event_registry_loads_and_repo_record_sites_clean():
+    """Every flightrec.record / rec(...) call site in the package uses a
+    literal kind from the EVENTS registry (ISSUE 8 satellite)."""
+    events = check_wrappers.load_event_registry(
+        REPO / "parameter_server_tpu" / check_wrappers.FLIGHTREC_MODULE
+    )
+    assert "frame.send" in events and "slo.breach" in events
+    problems = []
+    for f in sorted((REPO / "parameter_server_tpu").rglob("*.py")):
+        problems.extend(check_wrappers.check_flightrec_calls(f, events))
+    assert problems == [], "\n".join(problems)
+
+
+def test_catches_unregistered_kind(tmp_path):
+    bad = tmp_path / "bad_kind.py"
+    bad.write_text(
+        textwrap.dedent(
+            """
+            from parameter_server_tpu.core import flightrec
+
+            def fence(node):
+                flightrec.record("fence.incarnaton", node=node)  # typo
+            """
+        )
+    )
+    events = frozenset({"fence.incarnation"})
+    problems = check_wrappers.check_flightrec_calls(bad, events)
+    assert len(problems) == 1
+    assert "fence.incarnaton" in problems[0]
+
+
+def test_catches_unregistered_kind_via_alias_and_method(tmp_path):
+    bad = tmp_path / "bad_alias.py"
+    bad.write_text(
+        textwrap.dedent(
+            """
+            def sweep(recorder, rec):
+                rec("slo.braech", node="W0")              # aliased callable
+                recorder.record("frame.rejct", node="S0")  # method form
+            """
+        )
+    )
+    events = frozenset({"slo.breach", "frame.reject"})
+    problems = check_wrappers.check_flightrec_calls(bad, events)
+    assert len(problems) == 2
+    assert "slo.braech" in problems[0]
+    assert "frame.rejct" in problems[1]
+
+
+def test_catches_non_literal_kind_on_canonical_form(tmp_path):
+    bad = tmp_path / "bad_dynamic.py"
+    bad.write_text(
+        textwrap.dedent(
+            """
+            from parameter_server_tpu.core import flightrec
+
+            def log(kind):
+                flightrec.record(kind, node="S0")  # dynamic — unverifiable
+            """
+        )
+    )
+    problems = check_wrappers.check_flightrec_calls(bad, frozenset({"x.y"}))
+    assert len(problems) == 1
+    assert "non-literal" in problems[0]
+
+
+def test_record_shaped_non_recorder_calls_not_flagged(tmp_path):
+    ok = tmp_path / "ok_hist.py"
+    ok.write_text(
+        textwrap.dedent(
+            """
+            def measure(hist, lat):
+                hist.record(lat)           # histogram sample, not an event
+                hist.record(0.003)
+                db.record("row")           # undotted string: unrelated API
+            """
+        )
+    )
+    assert check_wrappers.check_flightrec_calls(ok, frozenset({"x.y"})) == []
+
+
+def test_registry_load_fails_loudly(tmp_path):
+    """A moved or computed EVENTS literal must raise, never yield an empty
+    registry that passes every call site vacuously."""
+    import pytest
+
+    missing = tmp_path / "no_registry.py"
+    missing.write_text("OTHER = frozenset({'a.b'})\n")
+    with pytest.raises(ValueError, match="EVENTS"):
+        check_wrappers.load_event_registry(missing)
+
+    computed = tmp_path / "computed.py"
+    computed.write_text("EVENTS = frozenset(sorted({'a.b'}))\n")
+    with pytest.raises(ValueError, match="literal"):
+        check_wrappers.load_event_registry(computed)
+
+    empty = tmp_path / "empty.py"
+    empty.write_text("EVENTS = frozenset(set())\n")
+    with pytest.raises(ValueError):
+        check_wrappers.load_event_registry(empty)
+
+
 def test_accepts_super_delegation(tmp_path):
     ok = tmp_path / "ok_van.py"
     ok.write_text(

@@ -27,6 +27,15 @@ PR 6, nothing enforced:
    Enforced as a module-level import ban on :data:`NO_PICKLE_MODULES`
    (``check_no_pickle``).
 
+4. **Flight-recorder kinds come from the closed registry.**  Every
+   ``flightrec.record("<kind>", ...)`` call site (and the aliased/method
+   forms ``rec(...)``, ``recorder.record(...)``) must pass a LITERAL kind
+   string present in ``core/flightrec.py``'s ``EVENTS`` frozenset —
+   otherwise the event taxonomy drifts stringly-typed and
+   ``tools/postmortem.py`` / the SLO plane silently miss events
+   (``check_flightrec_calls``; registry parsed by AST via
+   ``load_event_registry``, which fails loudly if the literal moves).
+
 Pure-AST check (no imports of the checked modules), so it runs in any
 environment and is wired as a tier-1 test (``tests/test_wrapper_contract.py``).
 Exit code 0 = clean; 1 = violations (one line each).
@@ -59,6 +68,14 @@ NO_PICKLE_MODULES = (
 _PICKLE_NAMES = frozenset(
     {"pickle", "cPickle", "_pickle", "dill", "cloudpickle", "marshal"}
 )
+
+#: module holding the closed event-kind registry (``EVENTS`` frozenset
+#: literal), relative to the package root.
+FLIGHTREC_MODULE = "core/flightrec.py"
+
+#: bare-callable names treated as flight-recorder record aliases (the
+#: ``rec = recorder.record or flightrec.record`` pattern in utils/slo.py).
+_RECORD_ALIASES = frozenset({"record", "rec"})
 
 
 def _base_names(cls: ast.ClassDef) -> List[str]:
@@ -162,11 +179,130 @@ def check_no_pickle(path: pathlib.Path) -> List[str]:
     return problems
 
 
+def load_event_registry(path: pathlib.Path) -> frozenset:
+    """Extract the ``EVENTS`` frozenset literal from ``core/flightrec.py``.
+
+    Parsed without importing (same stance as the rest of this tool), which
+    is why flightrec.py keeps ``EVENTS = frozenset({"...", ...})`` a plain
+    literal — no comprehension, no concatenation.  Raises ``ValueError``
+    when the assignment is missing, non-literal, or empty: a refactor that
+    moves the registry must break this check loudly, never let every call
+    site pass vacuously against an empty set.
+    """
+    tree = ast.parse(path.read_text(), filename=str(path))
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(
+            isinstance(t, ast.Name) and t.id == "EVENTS" for t in node.targets
+        ):
+            continue
+        value = node.value
+        if not (
+            isinstance(value, ast.Call)
+            and isinstance(value.func, ast.Name)
+            and value.func.id == "frozenset"
+            and len(value.args) == 1
+            and isinstance(value.args[0], (ast.Set, ast.List, ast.Tuple))
+        ):
+            raise ValueError(
+                f"{_rel(path)}:{node.lineno}: EVENTS must be a plain "
+                "frozenset({...}) literal of string constants (AST-parsed)"
+            )
+        kinds = []
+        for elt in value.args[0].elts:
+            if not (
+                isinstance(elt, ast.Constant) and isinstance(elt.value, str)
+            ):
+                raise ValueError(
+                    f"{_rel(path)}:{elt.lineno}: non-literal element in "
+                    "EVENTS — every kind must be a plain string constant"
+                )
+            kinds.append(elt.value)
+        if not kinds:
+            raise ValueError(f"{_rel(path)}: EVENTS registry is empty")
+        return frozenset(kinds)
+    raise ValueError(
+        f"{_rel(path)}: no module-level EVENTS assignment found — the "
+        "flight-recorder kind registry moved; update FLIGHTREC_MODULE"
+    )
+
+
+def _record_kind_arg(call: ast.Call):
+    """Classify ``call`` as a flight-recorder record site.
+
+    Returns ``(definitive, first_arg)`` for record-shaped calls, else None:
+
+    - ``flightrec.record(...)`` — the canonical module form — is DEFINITIVE:
+      a non-literal kind there is itself a violation;
+    - ``<expr>.record(...)`` / bare ``record(...)`` / ``rec(...)`` are
+      aliased forms, checked only when the first argument is a literal
+      dotted string (so ``histogram.record(0.003)`` never false-positives).
+    """
+    f = call.func
+    if (
+        isinstance(f, ast.Attribute)
+        and f.attr == "record"
+        and isinstance(f.value, ast.Name)
+        and f.value.id == "flightrec"
+    ):
+        return True, (call.args[0] if call.args else None)
+    shaped = (
+        (isinstance(f, ast.Attribute) and f.attr == "record")
+        or (isinstance(f, ast.Name) and f.id in _RECORD_ALIASES)
+    )
+    if shaped:
+        return False, (call.args[0] if call.args else None)
+    return None
+
+
+def check_flightrec_calls(path: pathlib.Path, events: frozenset) -> List[str]:
+    """Flag record calls whose kind is absent from the EVENTS registry."""
+    tree = ast.parse(path.read_text(), filename=str(path))
+    problems: List[str] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        classified = _record_kind_arg(node)
+        if classified is None:
+            continue
+        definitive, arg = classified
+        literal = (
+            arg.value
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str)
+            else None
+        )
+        if literal is None:
+            if definitive:
+                problems.append(
+                    f"{_rel(path)}:{node.lineno}: flightrec.record called "
+                    "with a non-literal kind — kinds must be literal strings "
+                    "from core/flightrec.py EVENTS so this check (and "
+                    "tools/postmortem.py) can see them statically"
+                )
+            continue  # aliased .record with non-string arg: not a recorder
+        if "." not in literal and not definitive:
+            continue  # aliased form with an undotted string: unrelated API
+        if literal not in events:
+            problems.append(
+                f"{_rel(path)}:{node.lineno}: record kind {literal!r} is not "
+                "in the EVENTS registry (core/flightrec.py) — add it there "
+                "or fix the typo; unknown kinds never reach postmortem / SLO "
+                "tooling"
+            )
+    return problems
+
+
 def main(argv: List[str]) -> int:
     roots = [pathlib.Path(a) for a in argv[1:]] or [PKG]
     problems: List[str] = []
     found_wrapper = False
     found_hot_path = 0
+    try:
+        events = load_event_registry(PKG / FLIGHTREC_MODULE)
+    except (OSError, ValueError) as e:
+        print(f"check_wrappers: event registry unreadable: {e}", file=sys.stderr)
+        return 1  # a moved/emptied registry must fail loudly, not pass
     for root in roots:
         files = [root] if root.is_file() else sorted(root.rglob("*.py"))
         for f in files:
@@ -177,6 +313,7 @@ def main(argv: List[str]) -> int:
             if rel in NO_PICKLE_MODULES:
                 found_hot_path += 1
                 problems.extend(check_no_pickle(f))
+            problems.extend(check_flightrec_calls(f, events))
             text = f.read_text()
             if "VanWrapper" not in text:
                 continue

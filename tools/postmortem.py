@@ -1,0 +1,176 @@
+#!/usr/bin/env python
+"""Merge flight-recorder bundles into one causal, clock-rebased timeline.
+
+``core/flightrec.py`` dumps one JSON bundle per node on failure (recv-thread
+exception, failing chaos test, explicit ``dump()``).  Loaded alone those are
+N disconnected rings; merged, the recipient's ``fence.routing`` lines up
+with the donor's ``resend.retransmit`` and the scheduler's ``node.restart``
+— the fence -> retransmit -> restart story a postmortem actually needs.
+
+Clock alignment reuses the two mechanisms the plane already has:
+
+- each bundle carries paired ``wall_anchor_s`` / ``mono_anchor_s`` anchors
+  captured together at recorder construction, so every monotonic event
+  stamp rebases onto the wall clock exactly as ``tools/merge_traces.py``
+  rebases chrome spans via ``metadata.clock_t0_s``;
+- each bundle's ``clock_offset_s`` (this node's monotonic clock minus the
+  scheduler's, from the heartbeat min-RTT sync —
+  ``FleetMonitor.clock_offset``) is subtracted, so cross-host rings line up
+  to RTT/2 accuracy.  In-process bundles share one clock and carry 0.
+
+Ordering is causal within the accuracy of those offsets: rebased time
+first, then (node, seq) — seq is per-recorder monotonic, so two events from
+one node can never invert.
+
+Usage::
+
+    python tools/postmortem.py bundles/flightrec_*.json
+    python tools/postmortem.py -o timeline.json --last 40 bundles/*.json
+
+The report prints the merged timeline tail — the "last N events before the
+first anomaly" (gave-up, fence, restart, abort, recv.exception,
+slo.breach...), plus everything after it — and ``-o`` writes the full
+merged timeline as JSON for tooling.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+#: event kinds that count as "something went wrong" for the report anchor.
+#: Mirrors ``flightrec.anomaly_kinds()`` — kept literal here so the tool
+#: runs standalone against bundle files with no package import.
+ANOMALY_KINDS = frozenset({
+    "frame.reject",
+    "resend.gave_up",
+    "fence.incarnation",
+    "fence.routing",
+    "node.restart",
+    "migrate.abort",
+    "recv.exception",
+    "slo.breach",
+})
+
+
+def load_bundle(path: str) -> dict:
+    """Read one per-node bundle; tolerates missing optional sections."""
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc.get("events"), list):
+        raise ValueError(f"{path}: not a flight-recorder bundle (no events)")
+    doc.setdefault(
+        "node", os.path.splitext(os.path.basename(path))[0]
+    )
+    return doc
+
+
+def merge_bundles(paths: List[str]) -> dict:
+    """Merge bundles into one causally ordered timeline document.
+
+    Every event gains ``node``, ``t_s`` (rebased wall-clock seconds), and
+    keeps its per-node ``seq``.  Rebase: ``wall_anchor + (t_mono -
+    mono_anchor) - clock_offset`` — subtracting the offset maps each node's
+    clock onto the shared scheduler reference.
+    """
+    bundles = [load_bundle(p) for p in paths]
+    events: List[dict] = []
+    for b in bundles:
+        wall = float(b.get("wall_anchor_s") or 0.0)
+        mono = float(b.get("mono_anchor_s") or 0.0)
+        off = float(b.get("clock_offset_s") or 0.0)
+        node = str(b["node"])
+        for ev in b["events"]:
+            ev = dict(ev)
+            t_mono = float(ev.get("t_mono_s") or 0.0)
+            ev["t_s"] = wall + (t_mono - mono) - off
+            ev.setdefault("node", node)
+            events.append(ev)
+    # causal order: rebased time, then (node, seq) so one node's events
+    # never invert even when stamps collide at clock resolution
+    events.sort(key=lambda e: (e["t_s"], str(e["node"]), e.get("seq", 0)))
+    return {
+        "nodes": sorted({str(b["node"]) for b in bundles}),
+        "counters": {
+            str(b["node"]): b.get("counters") or {} for b in bundles
+        },
+        "events": events,
+    }
+
+
+def first_anomaly(events: List[dict]) -> Optional[int]:
+    """Index of the first anomalous event in a merged timeline, or None."""
+    for i, ev in enumerate(events):
+        if ev.get("kind") in ANOMALY_KINDS:
+            return i
+    return None
+
+
+def report(merged: dict, *, last: int = 30) -> List[str]:
+    """Human-readable postmortem: the ``last`` events leading up to the
+    first anomaly, then everything from the anomaly on.  Returns lines."""
+    events = merged["events"]
+    lines = [
+        f"postmortem: {len(events)} events across "
+        f"{len(merged['nodes'])} nodes ({', '.join(merged['nodes'])})"
+    ]
+    if not events:
+        return lines + ["  (empty timeline)"]
+    anom = first_anomaly(events)
+    if anom is None:
+        lines.append("no anomalies recorded; timeline tail:")
+        window = events[-last:]
+    else:
+        ev = events[anom]
+        lines.append(
+            f"first anomaly: [{anom}] {ev['kind']} on {ev['node']} "
+            f"at t={ev['t_s']:.6f}"
+        )
+        lines.append(f"last {last} events before it, then the aftermath:")
+        window = events[max(0, anom - last):]
+    t0 = window[0]["t_s"]
+    for ev in window:
+        extras = {
+            k: v for k, v in ev.items()
+            if k not in ("t_s", "t_mono_s", "seq", "kind", "node")
+        }
+        mark = "!" if ev.get("kind") in ANOMALY_KINDS else " "
+        detail = " ".join(f"{k}={v}" for k, v in extras.items())
+        lines.append(
+            f" {mark} +{ev['t_s'] - t0:9.6f}s {str(ev['node']):>12s} "
+            f"{ev['kind']:<20s} {detail}".rstrip()
+        )
+    return lines
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="merge flight-recorder bundles into one causal timeline"
+    )
+    ap.add_argument("bundles", nargs="+", help="flightrec_*.json bundle files")
+    ap.add_argument(
+        "-o", "--output", default=None,
+        help="write the merged timeline JSON here (default: report only)",
+    )
+    ap.add_argument(
+        "--last", type=int, default=30,
+        help="events to show before the first anomaly (default: %(default)s)",
+    )
+    args = ap.parse_args(argv)
+    try:
+        merged = merge_bundles(args.bundles)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"postmortem: {e}", file=sys.stderr)
+        return 1
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(merged, f)
+    print("\n".join(report(merged, last=args.last)))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
