@@ -1788,18 +1788,32 @@ _OBS_NNZ = 26
 def _obs_run(*, observability: bool) -> float:
     """Seconds for ``_OBS_STEPS`` sparse-LR train steps over a loopback KV
     cluster — the headline pull/grad/push loop shape — with the whole
-    observability plane (MeteredVan + flight recorder) on or off."""
+    observability plane (MeteredVan + flight recorder + TelemetryBus
+    publishing into an SLO-evaluating aggregator) on or off.
+
+    The telemetry arm is deliberately harsher than production: a frame is
+    built, ingested, AND SLO-evaluated EVERY step (production rides the
+    ~1 Hz heartbeat cadence), so the 3% budget bounds the per-publish cost
+    itself, not just its amortized share.  The scheduler wire hop is a
+    direct ``agg.ingest`` handoff here — on a loopback plane the CONTROL
+    leg is one more in-process enqueue, which the heartbeat arm of the
+    fleet benches already price."""
     import jax.numpy as jnp
 
     from parameter_server_tpu.config import OptimizerConfig, TableConfig
     from parameter_server_tpu.core import flightrec
     from parameter_server_tpu.core.netmon import MeteredVan
     from parameter_server_tpu.core.postoffice import Postoffice
+    from parameter_server_tpu.core.telemetry import (
+        TelemetryAggregator,
+        TelemetryPublisher,
+    )
     from parameter_server_tpu.core.van import LoopbackVan
     from parameter_server_tpu.data.synthetic import SyntheticCTR
     from parameter_server_tpu.kv.server import KVServer
     from parameter_server_tpu.kv.worker import KVWorker
     from parameter_server_tpu.models import linear
+    from parameter_server_tpu.utils.slo import SloEngine, SloSpec
 
     rows = 1 << 16
     cfgs = {
@@ -1816,6 +1830,18 @@ def _obs_run(*, observability: bool) -> float:
             KVServer(Postoffice(f"S{s}", van), cfgs, s, 2) for s in range(2)
         ]
         worker = KVWorker(Postoffice("W0", van), cfgs, 2)
+        pub = agg = None
+        if observability:
+            pub = TelemetryPublisher("W0", van, sources=[worker])
+            agg = TelemetryAggregator(
+                window=_OBS_STEPS + _OBS_WARMUP,
+                slo=SloEngine([
+                    SloSpec(
+                        "stale-p99", "staleness.w", 64.0,
+                        source="p99", window_s=600.0, p99_scale=1.0,
+                    )
+                ]),
+            )
         data = SyntheticCTR(
             key_space=4 * rows, nnz=_OBS_NNZ, batch_size=_OBS_BATCH, seed=5
         )
@@ -1829,6 +1855,8 @@ def _obs_run(*, observability: bool) -> float:
             worker.push_sync(
                 "w", keys, np.asarray(g) / labels.shape[0], timeout=60
             )
+            if agg is not None:
+                agg.ingest("W0", pub.frame())
 
         for keys, labels in batches[:_OBS_WARMUP]:  # compile + caches warm
             step(keys, labels)
@@ -1849,12 +1877,14 @@ def _obs_run(*, observability: bool) -> float:
 
 
 def run_obs() -> tuple[dict, list[str]]:
-    """The ISSUE 8 guard: the headline sparse-LR loop with the recorder AND
-    MeteredVan fully on must stay within ``_OBS_BUDGET_PCT`` of the same
-    loop with everything off.  Arms interleave, each run reports its MEDIAN
-    per-step time, and the min over repeats is compared — the double
-    robustification a shared noisy host needs before a 3% bound means
-    anything.  Host-only: no device, no probe."""
+    """The ISSUE 8 guard, extended by ISSUE 10: the headline sparse-LR loop
+    with the recorder, MeteredVan AND per-step TelemetryBus publishing
+    (frame build + aggregator ingest + continuous SLO evaluation) fully on
+    must stay within ``_OBS_BUDGET_PCT`` of the same loop with everything
+    off.  Arms interleave, each run reports its MEDIAN per-step time, and
+    the min over repeats is compared — the double robustification a shared
+    noisy host needs before a 3% bound means anything.  Host-only: no
+    device, no probe."""
     on_s, off_s = [], []
     for _ in range(_OBS_REPEATS):
         off_s.append(_obs_run(observability=False))
@@ -1863,7 +1893,7 @@ def run_obs() -> tuple[dict, list[str]]:
     overhead_pct = (t_on - t_off) / t_off * 100.0
     passed = overhead_pct <= _OBS_BUDGET_PCT
     lines = [
-        f"obs overhead: recorder+metering on {t_on * 1e3:.3f} "
+        f"obs overhead: recorder+metering+telemetry on {t_on * 1e3:.3f} "
         f"ms/step vs off {t_off * 1e3:.3f} ms/step "
         f"-> {overhead_pct:+.2f}% (budget {_OBS_BUDGET_PCT}%): "
         f"{'PASS' if passed else 'FAIL'}",
@@ -1892,13 +1922,18 @@ def record_obs(record: dict, lines: list[str]) -> None:
         f"{record['repeats']} interleaved repeats, host CPU only, "
         "min-over-repeats compared.\n\n"
         "| arm | ms/step |\n|---|---|\n"
-        f"| recorder + MeteredVan fully on | {record['on_ms_per_step']} |\n"
+        "| recorder + MeteredVan + TelemetryBus (publish + ingest + SLO "
+        f"eval per step) | {record['on_ms_per_step']} |\n"
         f"| observability off | {record['off_ms_per_step']} |\n\n"
         f"Overhead: **{record['value']:+.2f}%** against a "
         f"{_OBS_BUDGET_PCT}% budget — "
         f"{'PASS' if record['pass'] else 'FAIL'}.  The flight recorder's "
         "per-event cost is one dict build + a GIL-atomic deque append; "
-        "MeteredVan adds a histogram bucket per delivery.\n"
+        "MeteredVan adds a histogram bucket per delivery; a telemetry "
+        "frame is delta-encoded (cost tracks what CHANGED since the last "
+        "publish) and here published every step — production rides the "
+        "~1 Hz heartbeat cadence, so this bounds the per-publish cost "
+        "itself.\n"
     )
     _splice_baseline(
         _OBS_BEGIN,
@@ -2902,9 +2937,10 @@ def emit_observability_artifacts(trace_dir: str) -> None:
     """``--trace-dir`` side artifacts beyond the bench's own phase trace:
     run a tiny 2-worker/2-server metered cluster and drop (a) per-node
     chrome traces, (b) the merged cross-node Perfetto timeline
-    (``tools/merge_traces.py``), and (c) a fleet-monitor JSONL — the full
-    observability-plane demo next to the BENCH_*.json record (README
-    "Observability" documents the fields)."""
+    (``tools/merge_traces.py``), (c) a fleet-monitor JSONL and (d) a live
+    telemetry ring spill (``telemetry.jsonl`` — feed it to
+    ``tools/pstop.py``) — the full observability-plane demo next to the
+    BENCH_*.json record (README "Observability" documents the fields)."""
     import importlib.util
 
     from parameter_server_tpu.config import OptimizerConfig, TableConfig
@@ -2916,6 +2952,10 @@ def emit_observability_artifacts(trace_dir: str) -> None:
         worker_id,
     )
     from parameter_server_tpu.core.netmon import MeteredVan
+    from parameter_server_tpu.core.telemetry import (
+        TelemetryAggregator,
+        TelemetryPublisher,
+    )
     from parameter_server_tpu.core.van import LoopbackVan
     from parameter_server_tpu.kv.server import KVServer
     from parameter_server_tpu.kv.worker import KVWorker
@@ -2940,11 +2980,16 @@ def emit_observability_artifacts(trace_dir: str) -> None:
         )
         fleet = FleetMonitor(jsonl=fleet_f)
         sched.fleet = fleet
+        sched.telemetry = TelemetryAggregator(
+            fleet=fleet,
+            jsonl_path=os.path.join(trace_dir, "telemetry.jsonl"),
+        )
         loc = {"w": HashLocalizer(rows)}
+        srvs = {}
         for i in range(ns):
             sid = server_id(i)
             tracers[sid] = Tracer()
-            KVServer(posts[sid], tables, i, ns, tracer=tracers[sid])
+            srvs[sid] = KVServer(posts[sid], tables, i, ns, tracer=tracers[sid])
         workers = {}
         for i in range(nw):
             wid = worker_id(i)
@@ -2953,6 +2998,11 @@ def emit_observability_artifacts(trace_dir: str) -> None:
                 posts[wid], tables, ns,
                 localizers=loc, tracer=tracers[wid],
             )
+        for nid, mgr in managers.items():
+            if nid != SCHEDULER:
+                mgr.telemetry_pub = TelemetryPublisher(
+                    nid, van, sources=[workers.get(nid) or srvs.get(nid)]
+                )
         rng = np.random.default_rng(0)
         for _ in range(3):  # a few push/pull rounds = trace + wire material
             for w in workers.values():
@@ -2962,8 +3012,13 @@ def emit_observability_artifacts(trace_dir: str) -> None:
                 w.pull_sync("w", keys)
             for nid, mgr in managers.items():
                 if nid != SCHEDULER:
-                    mgr.send_heartbeat()
-            fleet.write_jsonl()
+                    mgr.send_heartbeat()  # telemetry frames ride along
+            # one wall stamp per tick, shared by every sink written below —
+            # the rate-denominator skew fix of ISSUE 10 (a Dashboard on this
+            # tick would take the same stamp via record(now=wall))
+            wall = time.time()
+            fleet.write_jsonl(wall=wall)
+        sched.telemetry.close()
         paths = []
         for nid, tr in tracers.items():
             p = os.path.join(trace_dir, f"trace_{nid}.json")
@@ -2982,7 +3037,9 @@ def emit_observability_artifacts(trace_dir: str) -> None:
             json.dump(merged, f)
         print(
             f"observability artifacts in {trace_dir}: "
-            f"{len(paths)} node traces, merged_trace.json, fleet.jsonl",
+            f"{len(paths)} node traces, merged_trace.json, fleet.jsonl, "
+            "telemetry.jsonl (render: python tools/pstop.py --once "
+            f"{os.path.join(trace_dir, 'telemetry.jsonl')})",
             file=sys.stderr,
         )
     finally:

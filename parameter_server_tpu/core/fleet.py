@@ -360,17 +360,22 @@ class FleetMonitor:
         return flags
 
     # -- JSONL sink ----------------------------------------------------------
-    def write_jsonl(self, now: Optional[float] = None) -> Optional[dict]:
+    def write_jsonl(
+        self, now: Optional[float] = None, *, wall: Optional[float] = None
+    ) -> Optional[dict]:
         """Append one fleet snapshot line to the attached ``jsonl`` stream.
 
         Returns the row (or None without a sink).  Call per monitor sweep;
         one line = one fleet-wide observation, replayable offline.
+        ``wall``: the tick's shared wall-clock stamp — pass the same value
+        the co-running ``Dashboard.record(now=...)`` uses so a slow dump
+        cannot skew the two sinks' rate denominators apart.
         """
         if self.jsonl is None and self.jsonl_writer is None:
             return None
         now = time.monotonic() if now is None else now
         row = {
-            "t": time.time(),
+            "t": time.time() if wall is None else wall,
             "nodes": self.snapshot(now),
             "stragglers": self.stragglers(now),
         }

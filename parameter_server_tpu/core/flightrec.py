@@ -82,6 +82,10 @@ EVENTS = frozenset({
     "slo.clear",
     # bundle written (self-describing marker, last event in a bundle)
     "postmortem.dump",
+    # live telemetry plane (core/telemetry.py): frame published by a node /
+    # duplicate-seq frame dropped by the scheduler's aggregator
+    "telemetry.publish",
+    "telemetry.drop",
 })
 
 #: env var: when set, recv-thread exceptions auto-dump a bundle here.
@@ -134,6 +138,28 @@ class FlightRecorder:
             {"seq": seq, "t_mono_s": t, "kind": kind, **fields}
             for seq, t, kind, fields in list(self._ring)
         ]
+
+    def events_since(self, seq: int) -> List[dict]:
+        """Events with ``seq`` strictly greater than the watermark, oldest
+        first — the telemetry publisher's incremental scan.  Walks the ring
+        from the newest end and stops at the watermark, so a steady-state
+        caller pays O(new events), not O(capacity).  Iterates the live deque
+        (no snapshot copy); a concurrent append invalidates the iterator, in
+        which case the scan retries once against a snapshot."""
+        out: List[dict] = []
+        try:
+            for s, t, kind, fields in reversed(self._ring):
+                if s <= seq:
+                    break
+                out.append({"seq": s, "t_mono_s": t, "kind": kind, **fields})
+        except RuntimeError:  # ring mutated mid-scan
+            out = []
+            for s, t, kind, fields in reversed(list(self._ring)):
+                if s <= seq:
+                    break
+                out.append({"seq": s, "t_mono_s": t, "kind": kind, **fields})
+        out.reverse()
+        return out
 
     def clear(self) -> None:
         self._ring.clear()

@@ -46,6 +46,31 @@ PING = "ping"
 #: PR-6 routing-table broadcast: the scheduler owns the authoritative
 #: epoch-versioned RoutingTable and pushes new generations to the fleet.
 ROUTING = "routing"
+#: ISSUE-10 live telemetry: delta-encoded per-node frames riding the
+#: heartbeat cadence; the scheduler folds them into its TelemetryAggregator.
+TELEMETRY = "telemetry"
+
+#: The closed CONTROL-verb registry.  MUST stay a literal frozenset of
+#: plain strings — ``tools/check_wrappers.py`` parses this set out of the
+#: AST (no import) and verifies every ``{"cmd": ...}`` payload literal in
+#: the package names a registered verb.  Add new verbs here AND as a
+#: module constant above.
+CONTROL_VERBS = frozenset({
+    "register",
+    "add_node",
+    "remove_node",
+    "heartbeat",
+    "barrier",
+    "ping",
+    "routing",
+    "telemetry",
+})
+# import-time sync check: a verb constant that drifts from the registry
+# fails the import, not just the AST pass
+assert CONTROL_VERBS == frozenset({
+    REGISTER, ADD_NODE, REMOVE_NODE, HEARTBEAT, BARRIER, PING, ROUTING,
+    TELEMETRY,
+}), "CONTROL_VERBS out of sync with the verb constants"
 
 
 @dataclasses.dataclass
@@ -141,6 +166,13 @@ class Manager(Customer):
         #: scheduler-side sink for heartbeat stats (attach a
         #: ``core.fleet.FleetMonitor``); None = stats dropped as before.
         self.fleet = None
+        #: scheduler-side sink for TELEMETRY frames (attach a
+        #: ``core.telemetry.TelemetryAggregator``); None = frames dropped.
+        self.telemetry = None
+        #: node-side frame builder (attach a
+        #: ``core.telemetry.TelemetryPublisher``); when set,
+        #: ``send_heartbeat`` auto-publishes a frame after each beat.
+        self.telemetry_pub = None
         #: clock offset vs the scheduler (local minus scheduler monotonic,
         #: seconds) + the RTT of the winning sample — set by sync_clock().
         self.clock_offset: Optional[float] = None
@@ -221,6 +253,8 @@ class Manager(Customer):
             return self._on_ping(msg)
         elif cmd == ROUTING:
             self._on_routing(msg)
+        elif cmd == TELEMETRY:
+            self._on_telemetry(msg)
         return msg.reply()
 
     # -- routing-table broadcast (PR 6) --------------------------------------
@@ -593,6 +627,57 @@ class Manager(Customer):
             for cb in self.on_node_added:
                 cb(msg.sender)
 
+    # -- live telemetry (ISSUE 10) -------------------------------------------
+    def _on_telemetry(self, msg: Message) -> None:
+        """Scheduler: fold one TELEMETRY frame into the aggregator.
+
+        Guarded like ``_on_heartbeat`` — a malformed frame must never break
+        the CONTROL plane.  The reply (sent by ``handle_request`` after this
+        returns) therefore doubles as an ingest ack: a publisher that
+        ``wait()``s on its TELEMETRY ts knows the scheduler has evaluated.
+        """
+        agg = self.telemetry
+        if agg is None:
+            return
+        try:
+            agg.ingest(msg.sender, msg.task.payload.get("frame") or {})
+        except Exception:  # noqa: BLE001 — telemetry must never break CONTROL
+            logging.getLogger(__name__).exception(
+                "telemetry: bad frame from %s", msg.sender
+            )
+
+    def publish_telemetry(self) -> Optional[int]:
+        """Non-scheduler: build and send one telemetry frame.
+
+        Returns the submit ts (``wait()`` on it to block until the
+        scheduler has ingested + evaluated), or None when no publisher is
+        attached or frame construction failed — telemetry never raises into
+        the training loop.
+        """
+        pub = self.telemetry_pub
+        if pub is None:
+            return None
+        try:
+            frame = pub.frame()
+        except Exception:  # noqa: BLE001 — a broken stat source must not
+            # cost the caller (frame building walks user-attached sources)
+            logging.getLogger(__name__).exception(
+                "telemetry: frame build failed on %s", self.post.node_id
+            )
+            return None
+        return self.submit(
+            [
+                Message(
+                    task=Task(
+                        TaskKind.CONTROL,
+                        self.name,
+                        payload={"cmd": TELEMETRY, "frame": frame},
+                    ),
+                    recver=SCHEDULER,
+                )
+            ]
+        )
+
     # -- heartbeats / failure detection --------------------------------------
     def send_heartbeat(
         self, stats: Optional[dict] = None, *, auto: bool = True
@@ -641,7 +726,7 @@ class Manager(Customer):
                     "heartbeat: stat collection failed on %s",
                     self.post.node_id,
                 )
-        return self.submit(
+        ts = self.submit(
             [
                 Message(
                     task=Task(
@@ -653,6 +738,12 @@ class Manager(Customer):
                 )
             ]
         )
+        # telemetry rides the heartbeat cadence: the beat is submitted first
+        # so the scheduler's FleetMonitor has seen this node (clock offset,
+        # straggler state) before the frame is rebased against it
+        if self.telemetry_pub is not None:
+            self.publish_telemetry()
+        return ts
 
     def check_heartbeats(self) -> List[str]:
         """Scheduler: mark nodes silent past the timeout dead; broadcast.

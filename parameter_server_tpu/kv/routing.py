@@ -40,6 +40,11 @@ ROUTING_EPOCH_KEY = "__repoch__"
 ROUTING_KEY = "__routing__"
 #: reply payload key: marks a typed fence reject (wrong epoch / not owner).
 FENCED_KEY = "__fenced__"
+#: reply payload key: server-side segment version clock stamped onto PUSH
+#: acks and PULL replies (max over the segments the request touched) — the
+#: staleness plane's wire carrier (ISSUE 10).  Lives here with the other
+#: wire keys because it is part of the same request/reply payload contract.
+VERSION_KEY = "__sver__"
 
 
 @dataclasses.dataclass(frozen=True)

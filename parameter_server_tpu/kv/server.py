@@ -45,6 +45,7 @@ from parameter_server_tpu.kv.routing import (
     FENCED_KEY,
     ROUTING_EPOCH_KEY,
     ROUTING_KEY,
+    VERSION_KEY,
     RoutingTable,
 )
 from parameter_server_tpu.kv.table import KVTable
@@ -108,6 +109,16 @@ class KVServer(Customer):
         self.routing = routing or RoutingTable.uniform(table_cfgs, num_servers)
         self._shard_maps: Dict[str, tuple] = {
             t: self._make_map(self.routing, t) for t in table_cfgs
+        }
+        #: ISSUE-10 staleness plane: per-table, per-owned-segment version
+        #: clock (parallel to ``_shard_maps[t][0]``), bumped on every
+        #: push-apply touching the segment; the max over the segments a
+        #: request touches is stamped into its reply (``__sver__``) so
+        #: workers can measure update lag at use time.  Mutated only on the
+        #: recv thread (the single-writer table discipline).
+        self._seg_versions: Dict[str, np.ndarray] = {
+            t: np.zeros(self._shard_maps[t][0].shape[0], dtype=np.int64)
+            for t in table_cfgs
         }
         self.tables: Dict[str, KVTable] = {
             t: KVTable(
@@ -225,6 +236,40 @@ class KVServer(Customer):
         )
         return reply
 
+    # -- staleness version clock (ISSUE 10) -----------------------------------
+    def _touched_segments(self, table: str, keys) -> np.ndarray:
+        """Indices (into this shard's segment arrays) the request touches.
+
+        Pads (global id >= the table's global rows) touch nothing; un-owned
+        ids cannot reach here (the fence already rejected them).
+        """
+        starts, _, _ = self._shard_maps[table]
+        if starts.size == 0:
+            return np.empty(0, dtype=np.int64)
+        kn = np.asarray(keys, dtype=np.int64)
+        rk = kn[kn < self.routing.tables[table].rows]
+        if rk.size == 0:
+            return np.empty(0, dtype=np.int64)
+        return np.unique(np.searchsorted(starts, rk, side="right") - 1)
+
+    def version_max(self, table: str) -> int:
+        """Highest segment version of this shard (0 when it owns nothing)."""
+        ver = self._seg_versions[table]
+        return int(ver.max()) if ver.size else 0
+
+    def _stamp_version(self, msg: Message, reply: Message, sver: int) -> Message:
+        """Stamp ``__sver__`` onto a data reply, copy-on-write.
+
+        ``Message.reply`` shares the request's Task (and payload dict) — on
+        a Loopback plane that dict IS the sender's object, so the stamp must
+        replace the Task with a fresh payload, exactly as ``_fence_reply``
+        does, never mutate in place.
+        """
+        reply.task = dataclasses.replace(
+            msg.task, payload={**msg.task.payload, VERSION_KEY: sver}
+        )
+        return reply
+
     def _forward_push(self, tname: str, msg: Message) -> None:
         fwd = Message(
             task=Task(TaskKind.PUSH, self._fwd.name, payload={"table": tname}),
@@ -294,6 +339,11 @@ class KVServer(Customer):
             "rows_migrated_in": self.rows_migrated_in,
             "rows_migrated_out": self.rows_migrated_out,
             "migration_freeze_s": round(self.migration_freeze_s, 6),
+            # staleness plane: the shard's highest segment version, summed
+            # over tables — a cheap fleet-wide write-progress gauge
+            "seg_version_max": sum(
+                self.version_max(t) for t in self.tables
+            ),
         }
 
     # -- request handling -----------------------------------------------------
@@ -357,6 +407,16 @@ class KVServer(Customer):
             with self.tracer.span("kv.server.push", **span_attrs):
                 table.push(ids, vals)
             self.pushes += 1
+            # staleness clock: every apply bumps the touched segments; the
+            # ack carries the post-bump max so the pusher's next pulls can
+            # be measured against a version it knows it contributed to
+            segs = self._touched_segments(tname, msg.keys)
+            ver = self._seg_versions[tname]
+            if segs.size:
+                ver[segs] += 1
+                sver = int(ver[segs].max())
+            else:
+                sver = self.version_max(tname)
             if self._migrations:
                 # dirty tracking: rows in a migrating range changed after
                 # their chunk may have shipped — the commit delta re-sends
@@ -371,14 +431,24 @@ class KVServer(Customer):
                 # thread is the only writer), so the standby replays the
                 # identical update sequence
                 self._forward_push(tname, msg)
-            return msg.reply()
+            return self._stamp_version(msg, msg.reply(), sver)
         elif msg.task.kind == TaskKind.PULL:
             with self.tracer.span("kv.server.pull", **span_attrs):
                 rows = table.pull(ids)
             self.pulls += 1
+            # staleness clock: the reply carries the current version of the
+            # touched segments (read, not bumped) — what the worker computes
+            # on is exactly this version of those ranges
+            segs = self._touched_segments(tname, msg.keys)
+            ver = self._seg_versions[tname]
+            sver = (
+                int(ver[segs].max()) if segs.size else self.version_max(tname)
+            )
             if self.device_replies:
-                return msg.reply(values=[rows[:n]])
-            return msg.reply(values=[np.asarray(rows)[:n]])
+                return self._stamp_version(msg, msg.reply(values=[rows[:n]]), sver)
+            return self._stamp_version(
+                msg, msg.reply(values=[np.asarray(rows)[:n]]), sver
+            )
         raise ValueError(f"unsupported task kind {msg.task.kind}")
 
     # -- shard transfer (same-id restart: kv/replica.restart_same_id) --------
@@ -492,6 +562,17 @@ class KVServer(Customer):
         self.routing = new_routing
         self._shard_maps = {
             t: self._make_map(new_routing, t) for t in self.tables
+        }
+        # staleness clock across migrations: new segment layouts restart
+        # from the shard's previous MAX, so the per-table version never goes
+        # backwards (a worker's recorded last-push version stays comparable)
+        self._seg_versions = {
+            t: np.full(
+                self._shard_maps[t][0].shape[0],
+                self.version_max(t) if t in self._seg_versions else 0,
+                dtype=np.int64,
+            )
+            for t in self.tables
         }
 
     def _rebuild_table(

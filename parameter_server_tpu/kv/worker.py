@@ -47,11 +47,12 @@ from parameter_server_tpu.kv.routing import (
     FENCED_KEY,
     ROUTING_EPOCH_KEY,
     ROUTING_KEY,
+    VERSION_KEY,
     RoutingTable,
 )
 from parameter_server_tpu.ops import scatter
 from parameter_server_tpu.utils.keys import HashLocalizer, localize_to_slots
-from parameter_server_tpu.utils.trace import NULL_TRACER, Tracer
+from parameter_server_tpu.utils.trace import NULL_TRACER, LatencyHistogram, Tracer
 
 
 @functools.partial(jax.jit, static_argnames=("num_rows",))
@@ -115,6 +116,16 @@ class KVWorker(Customer):
         self.refresh_retries = 0
         #: cross-node trace ids (see :meth:`_trace_ctx`)
         self._trace_seq = itertools.count()
+        # -- staleness observability (ISSUE 10) ------------------------------
+        #: highest server version this worker's own pushes have been acked
+        #: at, per (table, server) — the baseline update lag is measured from
+        self._last_push_version: Dict[Tuple[str, str], int] = {}
+        #: update-lag distributions per (table, server), in VERSIONS (the
+        #: histogram's seconds axis reused as a unitless count axis)
+        self._staleness: Dict[Tuple[str, str], LatencyHistogram] = {}
+        self._staleness_lock = threading.Lock()
+        #: total lag samples recorded (Dashboard-mergeable gauge)
+        self.staleness_samples = 0
 
     # -- routing --------------------------------------------------------------
     def adopt_routing(self, routing) -> bool:
@@ -142,7 +153,69 @@ class KVWorker(Customer):
             "pull_retries": self.pull_retries,
             "push_retries": self.push_retries,
             "refresh_retries": self.refresh_retries,
+            "staleness_samples": self.staleness_samples,
         }
+
+    # -- staleness observability (ISSUE 10) -----------------------------------
+    def _on_response(self, msg) -> None:
+        """Tap every data reply for the server's ``__sver__`` version stamp.
+
+        Runs on the recv thread for push AND pull replies — including
+        fire-and-forget pushes whose bodies ``submit`` drops — so the
+        version bookkeeping is uniform across sync and async training.
+        PUSH acks advance this worker's last-pushed version for that
+        (table, server); PULL replies record ``server_version -
+        last_pushed_version`` — how many fleet updates the pulled ranges
+        have seen since this worker last contributed — into a per-range
+        histogram.  Cheap (two dict ops) and fail-safe: the super() call
+        that completes the task always runs.
+        """
+        try:
+            payload = msg.task.payload
+            sver = payload.get(VERSION_KEY)
+            table = payload.get("table")
+            if sver is not None and table is not None:
+                key = (table, msg.sender)
+                with self._staleness_lock:
+                    if msg.task.kind == TaskKind.PUSH:
+                        prev = self._last_push_version.get(key, 0)
+                        if sver > prev:
+                            self._last_push_version[key] = int(sver)
+                    elif msg.task.kind == TaskKind.PULL:
+                        last = self._last_push_version.get(key)
+                        if last is not None:
+                            hist = self._staleness.get(key)
+                            if hist is None:
+                                hist = self._staleness[key] = LatencyHistogram()
+                            hist.record(float(max(int(sver) - last, 0)))
+                            self.staleness_samples += 1
+        except Exception:  # noqa: BLE001 — observability must never lose
+            pass  # the reply itself
+        super()._on_response(msg)
+
+    def staleness_digests(self) -> Dict[str, dict]:
+        """Cumulative update-lag digests, named for the telemetry plane.
+
+        ``staleness.<table>`` merges every server's distribution (the
+        SLO-able fleet series, e.g. ``SloSpec("staleness.w", 8,
+        source="p99", p99_scale=1)``); ``staleness.<table>@<server>`` keeps
+        the per-key-range split for diagnosis.  Digests are cumulative and
+        monotone — ``TelemetryPublisher`` delta-encodes them.
+        """
+        with self._staleness_lock:
+            per_range = {
+                f"staleness.{t}@{s}": h.to_dict()
+                for (t, s), h in self._staleness.items()
+            }
+            merged: Dict[str, LatencyHistogram] = {}
+            for (t, _s), h in self._staleness.items():
+                agg = merged.get(t)
+                if agg is None:
+                    agg = merged[t] = LatencyHistogram()
+                agg.merge(h)
+        out = {f"staleness.{t}": h.to_dict() for t, h in merged.items()}
+        out.update(per_range)
+        return out
 
     @staticmethod
     def _scan_fences(responses, order) -> Tuple[list, set, List[np.ndarray]]:

@@ -235,9 +235,15 @@ class Dashboard:
     _net_t_last: Optional[float] = None
 
     def record(self, iteration: int, objective: float, extra: Optional[dict] = None,
-               examples: int = 0) -> None:
+               examples: int = 0, now: Optional[float] = None) -> None:
+        """``now``: the tick's shared wall-clock stamp (defaults to a fresh
+        ``time.time()``).  Callers that also write a fleet JSONL row this
+        tick should capture one stamp and pass it to BOTH this and
+        ``FleetMonitor.write_jsonl(wall=...)`` — otherwise every interval
+        rate here uses a denominator skewed by however long the other sink's
+        dump took."""
         self._examples += examples
-        now = time.time()
+        now = time.time() if now is None else now
         rel = (
             (objective - self._last_obj) / abs(self._last_obj)
             if self._last_obj not in (None, 0.0)

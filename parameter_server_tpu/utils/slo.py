@@ -54,10 +54,17 @@ class SloSpec:
     - ``"rate"``: (last - first) / elapsed over the window, for CUMULATIVE
       counters (``retransmits``, ``wire_bytes``) — ``max_value`` is per
       second;
-    - ``"p99"``: windowed p99 in MILLISECONDS of a cumulative
-      LatencyHistogram digest series — the window's delta histogram is
+    - ``"p99"``: windowed p99 of a cumulative LatencyHistogram digest
+      series, scaled by ``p99_scale`` — the window's delta histogram is
       reconstructed by differencing bucket counts, so the p99 covers only
       samples recorded inside the window, not the whole run.
+
+    ``p99_scale`` converts the histogram's native seconds axis into the
+    units ``max_value`` is written in: the default ``1e3`` reads latency
+    digests in milliseconds; unitless series that reuse the axis as a raw
+    count — the staleness version-lag digests of ISSUE 10 — pass ``1.0``
+    ("p99 staleness <= 8 versions" is ``SloSpec("stale", "staleness.w",
+    8.0, source="p99", p99_scale=1.0)``).
     """
 
     name: str
@@ -66,6 +73,7 @@ class SloSpec:
     source: str = "gauge"
     window_s: float = 10.0
     min_samples: int = 1
+    p99_scale: float = 1e3
 
     def __post_init__(self) -> None:
         if self.source not in _SOURCES:
@@ -75,6 +83,8 @@ class SloSpec:
             )
         if self.window_s <= 0:
             raise ValueError(f"SloSpec {self.name!r}: window_s must be > 0")
+        if self.p99_scale <= 0:
+            raise ValueError(f"SloSpec {self.name!r}: p99_scale must be > 0")
 
 
 @dataclasses.dataclass
@@ -110,11 +120,22 @@ class SloEngine:
             raise ValueError(f"duplicate SloSpec names: {sorted(names)}")
         self.specs = list(specs)
         self._recorder = recorder
+        #: metrics any spec reads — bulk ingest skips everything else.
+        self._spec_metrics = frozenset(s.metric for s in self.specs)
         #: (node, metric) -> deque of (t, value-or-digest-dict) samples.
         self._series: Dict[Tuple[str, str], Deque[Tuple[float, object]]] = {}
+        #: series keys that ever saw an out-of-order sample; only these pay
+        #: a sort in ``_windowed`` — the common in-order path appends are
+        #: already time-sorted.
+        self._unsorted: set = set()
+        self._last_obs_t: Dict[Tuple[str, str], float] = {}
         #: (spec name, node) -> currently breached?  (edge-trigger state)
         self._breached: Dict[Tuple[str, str], bool] = {}
         self._nodes: set = set()
+        #: high-water mark of evaluate's ``now`` — late re-evaluations are
+        #: clamped forward so an out-of-order caller cannot shrink the
+        #: window backwards and retro-flip an edge-triggered breach.
+        self._last_now: Optional[float] = None
 
     # -- ingest --------------------------------------------------------------
     def observe(
@@ -128,6 +149,11 @@ class SloEngine:
         dq = self._series.get(key)
         if dq is None:
             dq = self._series[key] = collections.deque(maxlen=1024)
+        last = self._last_obs_t.get(key)
+        if last is not None and now < last:
+            self._unsorted.add(key)
+        else:
+            self._last_obs_t[key] = now
         dq.append((now, value))
 
     def ingest_fleet(self, fleet, now: Optional[float] = None) -> None:
@@ -136,8 +162,9 @@ class SloEngine:
         specs over ``inbound_deliver``)."""
         now = time.monotonic() if now is None else now
         for node, row in fleet.snapshot(now).items():
+            self._nodes.add(node)  # verdict coverage even with no spec metric
             for metric, value in row.items():
-                if isinstance(value, (int, float)):
+                if metric in self._spec_metrics and isinstance(value, (int, float)):
                     self.observe(node, metric, float(value), now)
         wants_inbound = any(
             s.source == "p99" and s.metric == "inbound_deliver"
@@ -155,10 +182,13 @@ class SloEngine:
         self, node: str, counters: dict, now: Optional[float] = None
     ) -> None:
         """Sample a cumulative counter dict (``transport_counters`` output,
-        a server's ``counters()``) for ``rate`` and ``gauge`` specs."""
+        a server's ``counters()``) for ``rate`` and ``gauge`` specs.  Only
+        metrics some spec actually reads are retained — the telemetry plane
+        calls this once per frame with dozens of transport counters."""
         now = time.monotonic() if now is None else now
+        self._nodes.add(node)  # verdict coverage even with no spec metric
         for metric, value in counters.items():
-            if isinstance(value, (int, float)):
+            if metric in self._spec_metrics and isinstance(value, (int, float)):
                 self.observe(node, metric, float(value), now)
 
     # -- evaluation ----------------------------------------------------------
@@ -171,7 +201,14 @@ class SloEngine:
         if not dq:
             return None
         cutoff = now - spec.window_s
-        window = [(t, v) for t, v in dq if t >= cutoff]
+        # order by sample time, not append order: the live telemetry plane
+        # delivers frames out of order (ISSUE 10), and a LATE old sample
+        # must not masquerade as the window's latest gauge / rate endpoint.
+        # A series that only ever appended in order is already time-sorted;
+        # only series flagged by ``observe`` pay the sort.
+        window = [s for s in dq if s[0] >= cutoff]
+        if (node, spec.metric) in self._unsorted:
+            window.sort(key=lambda s: s[0])
         if len(window) < spec.min_samples:
             return None
         if spec.source == "gauge":
@@ -190,11 +227,20 @@ class SloEngine:
         delta = _delta_hist(first, last)
         if delta.count < spec.min_samples:
             return None
-        return 1e3 * delta.percentile(0.99)
+        return spec.p99_scale * delta.percentile(0.99)
 
     def evaluate(self, now: Optional[float] = None) -> Dict[str, SloVerdict]:
-        """Per-node verdicts; edge-triggers breach/clear recorder events."""
+        """Per-node verdicts; edge-triggers breach/clear recorder events.
+
+        ``now`` only moves forward: an evaluation stamped EARLIER than a
+        previous one (a late telemetry frame re-triggering the sweep) is
+        evaluated at the high-water clock, so an already-fired breach edge
+        cannot retro-flip on stale time.
+        """
         now = time.monotonic() if now is None else now
+        if self._last_now is not None and now < self._last_now:
+            now = self._last_now
+        self._last_now = now
         # explicit None test: an EMPTY FlightRecorder is falsy (__len__ == 0),
         # and the first breach is exactly when the injected recorder is empty
         rec = (

@@ -160,6 +160,95 @@ def test_validate_catches_malformed_events(mt):
     assert mt.validate_chrome_trace({}) == ["traceEvents missing or not a list"]
 
 
+def _write_bundle(path, node, events, *, mono=100.0, wall=5000.0, off=0.0):
+    doc = {
+        "node": node,
+        "wall_anchor_s": wall,
+        "mono_anchor_s": mono,
+        "clock_offset_s": off,
+        "counters": {},
+        "events": events,
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f)
+
+
+def test_flightrec_bundle_bridges_as_instants(mt, tmp_path):
+    """ISSUE 10 satellite: a flight-recorder bundle merges alongside a
+    chrome trace as validated Perfetto instant events carrying the journal
+    fields, on its own pid."""
+    trace = str(tmp_path / "trace_W0.json")
+    with open(trace, "w") as f:
+        json.dump({
+            "traceEvents": [
+                {"name": "kv.push", "ph": "X", "ts": 0.0, "dur": 10.0,
+                 "pid": 1, "tid": 1}
+            ],
+            "metadata": {"node": "W0", "clock_t0_s": 100.0},
+        }, f)
+    bundle = str(tmp_path / "flightrec_S0.json")
+    _write_bundle(bundle, "S0", [
+        {"seq": 1, "t_mono_s": 100.5, "kind": "resend.retransmit",
+         "node": "S0", "attempt": 2},
+        {"seq": 2, "t_mono_s": 101.0, "kind": "slo.breach", "node": "S0"},
+    ])
+    merged = mt.merge_traces([trace, bundle])
+    assert mt.validate_chrome_trace(merged) == []
+    inst = [e for e in merged["traceEvents"] if e.get("ph") == "i"]
+    assert [e["name"] for e in inst] == ["resend.retransmit", "slo.breach"]
+    span_pid = next(
+        e["pid"] for e in merged["traceEvents"] if e.get("ph") == "X"
+    )
+    assert all(e["pid"] != span_pid for e in inst)  # own Perfetto process
+    assert inst[0]["args"]["attempt"] == 2  # journal fields preserved
+    assert inst[0]["s"] == "p"
+    # both files embed epoch 100.0 -> shared base; 0.5s after the anchor
+    assert inst[0]["ts"] == pytest.approx(0.5e6)
+    names = {
+        e["args"]["name"] for e in merged["traceEvents"]
+        if e["name"] == "process_name"
+    }
+    assert names == {"W0", "S0"}
+
+
+def test_bundle_clock_offset_rebases_onto_scheduler_domain(mt, tmp_path):
+    """A bundle whose node clock runs 2s ahead (clock_offset_s=2) lands 2s
+    earlier after the rebase — aligned with the scheduler-domain trace."""
+    trace = str(tmp_path / "trace_sched.json")
+    with open(trace, "w") as f:
+        json.dump({
+            "traceEvents": [
+                {"name": "op", "ph": "X", "ts": 0.0, "dur": 1.0,
+                 "pid": 1, "tid": 1}
+            ],
+            "metadata": {"node": "SCHED", "clock_t0_s": 98.0},
+        }, f)
+    bundle = str(tmp_path / "flightrec_W1.json")
+    _write_bundle(
+        bundle, "W1",
+        [{"seq": 1, "t_mono_s": 100.5, "kind": "fence.routing", "node": "W1"}],
+        mono=100.0, off=2.0,
+    )
+    merged = mt.merge_traces([trace, bundle])
+    assert mt.validate_chrome_trace(merged) == []
+    inst = next(e for e in merged["traceEvents"] if e.get("ph") == "i")
+    # scheduler-domain absolute time: 100.5 - 2.0 = 98.5 = base(98.0) + 0.5
+    assert inst["ts"] == pytest.approx(0.5e6)
+
+
+def test_validate_catches_malformed_instants(mt):
+    bad = {
+        "traceEvents": [
+            {"name": "ok", "ph": "i", "ts": 1.0, "pid": 1, "tid": 0, "s": "p"},
+            {"name": "nots", "ph": "i", "pid": 1, "tid": 0},        # no ts
+            {"name": "scope", "ph": "i", "ts": 1.0, "pid": 1, "tid": 0,
+             "s": "z"},                                             # bad scope
+        ]
+    }
+    problems = mt.validate_chrome_trace(bad)
+    assert len(problems) == 2
+
+
 def test_cli_writes_merged_output(mt, tmp_path, capsys):
     paths = _run_traced_cluster(tmp_path)
     out = str(tmp_path / "merged.json")

@@ -1,0 +1,455 @@
+"""Live telemetry plane + staleness observability (ISSUE 10 tentpole).
+
+Acceptance anchors:
+
+1. under seeded async training with ``ChaosVan.slow_node`` on one worker,
+   that worker's staleness p99 visibly diverges from the fleet, a
+   staleness ``SloSpec`` breaches on the live TELEMETRY stream (and never
+   on the clean run), and ``SloEngine.healthy()`` flips WITHOUT any
+   explicit dump/ingest call by the test;
+2. the SLO engine is robust to the live plane's failure modes: frames
+   arriving out of order and nonzero clock offsets (a late frame must not
+   retro-flip an edge-triggered breach) — ISSUE 10 satellite;
+3. unit coverage: delta encoding round-trips, publisher seq/watermark
+   behavior, aggregator dedup/late/rebase, JSONL spill -> ``tools/pstop``.
+"""
+
+import json
+import pathlib
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from parameter_server_tpu.config import OptimizerConfig, TableConfig
+from parameter_server_tpu.core import flightrec
+from parameter_server_tpu.core.chaos import ChaosVan
+from parameter_server_tpu.core.fleet import FleetMonitor
+from parameter_server_tpu.core.manager import SCHEDULER, launch_local_cluster
+from parameter_server_tpu.core.messages import server_id, worker_id
+from parameter_server_tpu.core.netmon import MeteredVan
+from parameter_server_tpu.core.postoffice import Postoffice
+from parameter_server_tpu.core.resender import ReliableVan
+from parameter_server_tpu.core.telemetry import (
+    TelemetryAggregator,
+    TelemetryPublisher,
+    delta_digest,
+)
+from parameter_server_tpu.core.van import LoopbackVan
+from parameter_server_tpu.kv.server import KVServer
+from parameter_server_tpu.kv.worker import KVWorker
+from parameter_server_tpu.utils.slo import SloEngine, SloSpec
+from parameter_server_tpu.utils.trace import LatencyHistogram
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+import pstop  # noqa: E402
+
+ROWS = 1 << 10
+
+
+def _table_cfgs():
+    return {
+        "w": TableConfig(
+            name="w", rows=ROWS, dim=2,
+            optimizer=OptimizerConfig(kind="sgd", learning_rate=0.1),
+        )
+    }
+
+
+# ------------------------------------------------------------ delta encoding
+
+
+def test_delta_digest_sparse_roundtrip():
+    h = LatencyHistogram()
+    for v in (0.001, 0.002, 0.005):
+        h.record(v)
+    prev = h.to_dict()
+    h.record(0.009)
+    h.record(0.009)
+    cur = h.to_dict()
+    dd = delta_digest(prev, cur)
+    assert dd["count"] == 2
+    # reconstructing prev + delta yields cur's distribution
+    back = LatencyHistogram.from_dict(prev)
+    back.merge(LatencyHistogram.from_dict(dd))
+    assert back.count == h.count
+    assert back.percentile(0.99) == h.percentile(0.99)
+
+
+def test_delta_digest_nothing_new_is_none():
+    h = LatencyHistogram()
+    h.record(0.001)
+    d = h.to_dict()
+    assert delta_digest(d, d) is None
+    assert delta_digest(None, {"count": 0}) is None
+    assert delta_digest(d, None) is None
+
+
+def test_delta_digest_reset_falls_back_to_full():
+    h = LatencyHistogram()
+    for _ in range(5):
+        h.record(0.001)
+    big = h.to_dict()
+    h2 = LatencyHistogram()
+    h2.record(0.002)
+    small = h2.to_dict()
+    # count moved backwards: recorder restarted -> full current digest
+    assert delta_digest(big, small) == small
+
+
+# ----------------------------------------------------------------- publisher
+
+
+class _Src:
+    """Minimal telemetry source: counters + one staleness series."""
+
+    def __init__(self):
+        self.hist = LatencyHistogram()
+        self.n = 0
+
+    def counters(self):
+        return {"pushes": self.n}
+
+    def staleness_digests(self):
+        return {"staleness.w": self.hist.to_dict()}
+
+
+def test_publisher_emits_deltas_and_advances_seq():
+    src = _Src()
+    rec = flightrec.FlightRecorder(capacity=64)
+    pub = TelemetryPublisher("W0", None, recorder=rec, sources=[src])
+    src.n = 3
+    src.hist.record(1.0)
+    f1 = pub.frame(now=1.0)
+    assert (f1["v"], f1["node"], f1["seq"]) == (1, "W0", 1)
+    assert f1["counters"] == {"pushes": 3}
+    assert f1["staleness"]["staleness.w"]["count"] == 1
+    # nothing changed: the next frame carries no counter/staleness sections
+    f2 = pub.frame(now=2.0)
+    assert f2["seq"] == 2
+    assert "counters" not in f2 and "staleness" not in f2
+    src.n = 5
+    f3 = pub.frame(now=3.0)
+    assert f3["counters"] == {"pushes": 2}  # delta, not cumulative
+
+
+def test_publisher_event_watermark_counts_each_event_once():
+    rec = flightrec.FlightRecorder(capacity=64)
+    pub = TelemetryPublisher("W0", None, recorder=rec)
+    rec.record("frame.send", node="W0")
+    rec.record("frame.send", node="W0")
+    rec.record("frame.send", node="S9")  # other node: attributed, not echoed
+    f1 = pub.frame(now=1.0)
+    assert f1["events"] == {"frame.send": 2}
+    f2 = pub.frame(now=2.0)
+    assert "events" not in f2  # watermark advanced: nothing re-reported
+    rec.record("frame.recv", node="W0")
+    assert pub.frame(now=3.0)["events"] == {"frame.recv": 1}
+
+
+# ---------------------------------------------------------------- aggregator
+
+
+def test_aggregator_drops_duplicate_frames():
+    flightrec.configure(clear=True)
+    try:
+        agg = TelemetryAggregator()
+        rec_pub = flightrec.FlightRecorder(capacity=16)
+        pub = TelemetryPublisher("W0", None, recorder=rec_pub)
+        f = pub.frame(now=1.0)
+        assert agg.ingest("W0", f, now=1.0)
+        assert not agg.ingest("W0", dict(f), now=1.1)  # replay
+        assert agg.counters()["telemetry_dup_frames"] == 1
+        drops = [
+            e for e in flightrec.get().events()
+            if e["kind"] == "telemetry.drop"
+        ]
+        assert drops and drops[0]["node"] == "W0"
+        assert len(agg.rows("W0")) == 1  # the dup added no row
+    finally:
+        flightrec.configure(clear=True)
+
+
+def test_aggregator_rebases_sender_clock_and_counts_late_frames():
+    class _Fleet:
+        def clock_offset(self, node):
+            return 5.0  # node clock runs 5s ahead of the scheduler
+
+        def stragglers(self, now):
+            return {}
+
+    agg = TelemetryAggregator(fleet=_Fleet())
+    assert agg.ingest("W0", {"seq": 1, "t_mono_s": 105.0}, now=50.0)
+    row = agg.latest()["W0"]
+    assert row["t"] == pytest.approx(100.0)  # 105 - offset
+    # newer seq, older sender stamp: kept, but flagged late (no rates)
+    assert agg.ingest("W0", {"seq": 2, "t_mono_s": 104.0}, now=51.0)
+    assert agg.counters()["telemetry_late_frames"] == 1
+    assert "msgs_per_s" not in agg.latest()["W0"]
+
+
+def test_aggregator_ring_is_bounded_and_spills_jsonl(tmp_path):
+    path = str(tmp_path / "telemetry.jsonl")
+    agg = TelemetryAggregator(window=4, jsonl_path=path)
+    for i in range(1, 11):
+        agg.ingest("W0", {"seq": i, "t_mono_s": float(i)}, now=float(i))
+    assert len(agg.rows("W0")) == 4  # ring bound
+    agg.close()
+    lines = [
+        json.loads(ln)
+        for ln in pathlib.Path(path).read_text().splitlines() if ln
+    ]
+    assert len(lines) == 10  # the spill keeps what the ring evicted
+    assert [r["seq"] for r in lines] == list(range(1, 11))
+
+
+def test_pstop_renders_spill(tmp_path):
+    path = str(tmp_path / "telemetry.jsonl")
+    src = _Src()
+    rec = flightrec.FlightRecorder(capacity=16)
+    pub = TelemetryPublisher("W0", None, recorder=rec, sources=[src])
+    eng = SloEngine([
+        SloSpec("stale", "staleness.w", 2.0, source="p99",
+                window_s=600.0, min_samples=1, p99_scale=1.0),
+    ], recorder=rec)
+    agg = TelemetryAggregator(slo=eng, jsonl_path=path)
+    src.hist.record(1.0)
+    agg.ingest("W0", pub.frame(now=1.0), now=1.0)
+    src.hist.record(9.0)
+    src.hist.record(9.0)
+    agg.ingest("W0", pub.frame(now=2.0), now=2.0)
+    with open(path, "a") as f:
+        f.write('{"torn json...\n')  # reader must skip a torn line
+    agg.close()
+    latest = pstop.load_rows(path)
+    assert set(latest) == {"W0"} and latest["W0"]["seq"] == 2
+    out = "\n".join(pstop.render(latest))
+    assert "W0" in out and "BREACH:stale" in out
+    assert "9/9" in out  # staleness p50/p99 column
+    assert pstop.render({}) == ["(no telemetry rows yet)"]
+
+
+# ---------------------- satellite: SLO under clock offsets + reordering
+
+
+def _digests(values):
+    """Cumulative staleness digests after each prefix of ``values``."""
+    h = LatencyHistogram()
+    out = []
+    for v in values:
+        h.record(float(v))
+        out.append(h.to_dict())
+    return out
+
+
+def test_windowed_gauge_sorts_out_of_order_samples():
+    eng = SloEngine([SloSpec("g", "lag", 10.0, window_s=100.0)])
+    eng.observe("W0", "lag", 50.0, now=5.0)
+    eng.observe("W0", "lag", 1.0, now=3.0)  # LATE arrival of an older sample
+    v = eng.evaluate(now=6.0)["W0"]
+    # the window's latest gauge is the newest BY TIME, not by append order
+    assert v.observed["g"] == 50.0
+    assert not v.healthy
+
+
+def test_late_frame_cannot_retroflip_edge_triggered_breach():
+    rec = flightrec.FlightRecorder(capacity=64)
+    eng = SloEngine([
+        SloSpec("stale", "staleness.w", 8.0, source="p99",
+                window_s=30.0, min_samples=2, p99_scale=1.0),
+    ], recorder=rec)
+    d = _digests([1.0, 1.0, 20.0, 20.0])
+    eng.observe("W1", "staleness.w", d[1], now=100.0)
+    eng.observe("W1", "staleness.w", d[3], now=110.0)
+    eng.evaluate(now=110.0)
+    assert not eng.healthy("W1")
+    kinds = [e["kind"] for e in rec.events()]
+    assert kinds == ["slo.breach"]
+    # a LATE frame arrives carrying an old digest and an old clock: the
+    # evaluation clamps to the high-water now, so the breach edge holds
+    eng.observe("W1", "staleness.w", d[0], now=95.0)
+    eng.evaluate(now=96.0)
+    assert not eng.healthy("W1")
+    kinds = [e["kind"] for e in rec.events()]
+    assert kinds == ["slo.breach"]  # no clear, no re-breach
+
+
+def test_slo_windows_align_under_nonzero_clock_offset():
+    """Two nodes with a 5s clock skew: the aggregator rebases frames into
+    the scheduler domain before feeding the engine, so both nodes' samples
+    land in one comparable window."""
+
+    class _Fleet:
+        def clock_offset(self, node):
+            return {"W0": 0.0, "W1": 5.0}[node]
+
+        def stragglers(self, now):
+            return {}
+
+    eng = SloEngine([
+        SloSpec("stale", "staleness.w", 8.0, source="p99",
+                window_s=60.0, min_samples=2, p99_scale=1.0),
+    ])
+    agg = TelemetryAggregator(slo=eng, fleet=_Fleet())
+    d = _digests([1.0, 1.0])
+    # same scheduler-domain instants, expressed in each node's own clock
+    for node, skew in (("W0", 0.0), ("W1", 5.0)):
+        agg.ingest(node, {
+            "seq": 1, "t_mono_s": 100.0 + skew,
+            "staleness": {"staleness.w": d[0]},
+        }, now=100.0)
+        agg.ingest(node, {
+            "seq": 2, "t_mono_s": 110.0 + skew,
+            "staleness": {"staleness.w": delta_digest(d[0], d[1]) or {}},
+        }, now=110.0)
+    for node in ("W0", "W1"):
+        times = [t for t, _ in eng._series[(node, "staleness.w")]]
+        assert times == [pytest.approx(100.0), pytest.approx(110.0)]
+        assert eng.healthy(node)
+
+
+# --------------------------- acceptance: live staleness breach vs slow_node
+
+
+@pytest.mark.chaos
+def test_staleness_slo_breaches_live_under_slow_worker():
+    """Full Metered(Reliable(Chaos(Loopback))) stack with telemetry riding
+    heartbeats: the slowed worker's staleness p99 diverges from the fleet
+    and the staleness SLO breaches ON ARRIVAL of the live TELEMETRY
+    stream — the test never calls ``evaluate``/``ingest`` itself — and
+    never during the clean phase.
+
+    The async schedule is driven explicitly for determinism: ``slow_node``
+    delays every delivery INTO W1 by 60ms, so each W1 round trip eats
+    ~120ms of injected latency while W0 (a few ms per round) keeps
+    pushing — the test pins that ratio at 12 healthy rounds per straggler
+    round instead of racing wall-clock threads, which makes the measured
+    version lag (~12 vs ~1) exact rather than scheduler-dependent."""
+    flightrec.configure(clear=True)
+    chaos = ChaosVan(LoopbackVan(), seed=0)
+    van = MeteredVan(
+        ReliableVan(chaos, timeout=5.0, backoff=1.0, max_retries=3, seed=0)
+    )
+    rec = flightrec.FlightRecorder(capacity=256)
+    try:
+        sched, managers, posts = launch_local_cluster(
+            van, num_workers=2, num_servers=2
+        )
+        fleet = FleetMonitor()
+        sched.fleet = fleet
+        eng = SloEngine([
+            SloSpec("staleness-p99", "staleness.w", 8.0, source="p99",
+                    window_s=600.0, min_samples=2, p99_scale=1.0),
+        ], recorder=rec)
+        sched.telemetry = TelemetryAggregator(slo=eng, fleet=fleet)
+        cfgs = _table_cfgs()
+        servers = [
+            KVServer(posts[server_id(s)], cfgs, s, 2) for s in range(2)
+        ]
+        workers = {
+            worker_id(w): KVWorker(posts[worker_id(w)], cfgs, 2, min_bucket=16)
+            for w in range(2)
+        }
+        for nid, mgr in managers.items():
+            if nid == SCHEDULER:
+                continue
+            mgr.telemetry_pub = TelemetryPublisher(
+                nid, van,
+                sources=[workers[nid]] if nid in workers else [],
+            )
+
+        def publish_all():
+            # heartbeat first (clock/straggler state), then one frame whose
+            # ts we CAN wait on — ingestion + evaluation happen before the
+            # scheduler's reply, so this blocks until verdicts are current
+            for nid, mgr in managers.items():
+                if nid == SCHEDULER:
+                    continue
+                assert mgr.wait(mgr.send_heartbeat(), timeout=60)
+                ts = mgr.publish_telemetry()
+                assert ts is not None and mgr.wait(ts, timeout=60)
+
+        def step(wid, rng):
+            w = workers[wid]
+            keys = rng.integers(0, ROWS, size=48).astype(np.uint64)
+            w.pull_sync("w", keys, timeout=60)
+            assert w.wait(
+                w.push("w", keys, rng.standard_normal((48, 2)).astype(np.float32)),
+                timeout=60,
+            )
+
+        rngs = {wid: np.random.default_rng(i) for i, wid in enumerate(workers)}
+        for _ in range(3):  # clean phase: both workers in lockstep
+            for wid in workers:
+                step(wid, rngs[wid])
+            publish_all()
+        assert all(eng.healthy(wid) for wid in workers)
+        assert [e["kind"] for e in rec.events()] == []  # no breach when clean
+        assert all(w.staleness_samples > 0 for w in workers.values())
+
+        chaos.slow_node(worker_id(1), 60.0)  # the straggler
+        t0 = time.monotonic()
+        for _ in range(5):
+            for _ in range(12):  # W0 trains on while W1's round crawls
+                step(worker_id(0), rngs[worker_id(0)])
+            step(worker_id(1), rngs[worker_id(1)])  # ~120ms injected latency
+            publish_all()  # the live stream, at heartbeat cadence
+        # the straggler's rounds really were wire-delayed, not just scheduled
+        assert time.monotonic() - t0 > 5 * 0.12
+        publish_all()  # final frames carry the last staleness deltas
+
+        # healthy() flipped purely from wire-delivered frames
+        assert not eng.healthy(worker_id(1))
+        assert eng.healthy(worker_id(0))
+        breaches = [e for e in rec.events() if e["kind"] == "slo.breach"]
+        assert breaches and {e["node"] for e in breaches} == {worker_id(1)}
+        assert all(e["slo"] == "staleness-p99" for e in breaches)
+        # the straggler's update-lag distribution visibly diverged
+        agg = sched.telemetry
+        p99_slow = agg.staleness_quantile(worker_id(1), "staleness.w", 0.99)
+        p99_fast = agg.staleness_quantile(worker_id(0), "staleness.w", 0.99)
+        assert p99_slow > 8.0 >= p99_fast, (p99_slow, p99_fast)
+        lat = agg.latest()
+        assert lat[worker_id(1)]["healthy"] is False
+        assert "staleness-p99" in lat[worker_id(1)].get("breaches", [])
+        assert chaos.injected_slow > 0
+        del servers
+    finally:
+        van.close()
+        flightrec.configure(clear=True)
+
+
+# ------------------------------------------------- wire plumbing (manager)
+
+
+def test_telemetry_rides_heartbeat_and_dedups_on_wire():
+    """A publisher attached to a manager publishes on every heartbeat; the
+    scheduler-side aggregator sees monotonically increasing seqs and drops
+    a replayed frame."""
+    flightrec.configure(clear=True)
+    van = LoopbackVan()
+    try:
+        sched, managers, posts = launch_local_cluster(
+            van, num_workers=1, num_servers=1
+        )
+        sched.telemetry = TelemetryAggregator()
+        wid = worker_id(0)
+        mgr = managers[wid]
+        mgr.telemetry_pub = TelemetryPublisher(wid, van)
+        for _ in range(3):
+            assert mgr.wait(mgr.send_heartbeat(), timeout=60)
+        ts = mgr.publish_telemetry()
+        assert ts is not None and mgr.wait(ts, timeout=60)
+        rows = sched.telemetry.rows(wid)
+        assert [r["seq"] for r in rows] == [1, 2, 3, 4]
+        # replayed frame (same seq) is dropped, not double-counted
+        f = dict(rows[-1])
+        assert not sched.telemetry.ingest(wid, {"seq": 4})
+        assert sched.telemetry.counters()["telemetry_dup_frames"] == 1
+        del f
+    finally:
+        van.close()
+        flightrec.configure(clear=True)

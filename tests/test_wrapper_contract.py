@@ -206,6 +206,68 @@ def test_registry_load_fails_loudly(tmp_path):
         check_wrappers.load_event_registry(empty)
 
 
+def test_verb_registry_loads_and_repo_cmd_sites_clean():
+    """Every ``{"cmd": ...}`` payload literal in the package names a verb
+    from the CONTROL_VERBS registry (ISSUE 10 satellite), and the new
+    telemetry event kinds are registered."""
+    verbs, names = check_wrappers.load_verb_registry(
+        REPO / "parameter_server_tpu" / check_wrappers.MANAGER_MODULE
+    )
+    assert "telemetry" in verbs and "heartbeat" in verbs
+    assert names.get("TELEMETRY") == "telemetry"
+    events = check_wrappers.load_event_registry(
+        REPO / "parameter_server_tpu" / check_wrappers.FLIGHTREC_MODULE
+    )
+    assert "telemetry.publish" in events and "telemetry.drop" in events
+    problems = []
+    for f in sorted((REPO / "parameter_server_tpu").rglob("*.py")):
+        problems.extend(check_wrappers.check_control_verbs(f, verbs, names))
+    assert problems == [], "\n".join(problems)
+
+
+def test_catches_unknown_cmd_literal_and_computed_value(tmp_path):
+    bad = tmp_path / "bad_cmd.py"
+    bad.write_text(
+        textwrap.dedent(
+            """
+            def send(mgr, verb):
+                mgr.submit({"cmd": "telemtry"})       # typo literal
+                mgr.submit({"cmd": verb})             # unknown name
+                mgr.submit({"cmd": "heartbeat"})      # fine: registered
+                mgr.submit({"cmd": HEARTBEAT})        # fine: verb constant
+                mgr.submit({"cmd": manager.TELEMETRY})  # fine: dotted form
+            """
+        )
+    )
+    verbs = frozenset({"heartbeat", "telemetry"})
+    names = {"HEARTBEAT": "heartbeat", "TELEMETRY": "telemetry"}
+    problems = check_wrappers.check_control_verbs(bad, verbs, names)
+    assert len(problems) == 2
+    assert "telemtry" in problems[0]
+    assert "not a" in problems[1]
+
+
+def test_verb_registry_load_fails_loudly(tmp_path):
+    """Same stance as the event registry: a moved/computed CONTROL_VERBS
+    literal (or a registry with no matching verb constants) raises."""
+    import pytest
+
+    missing = tmp_path / "no_verbs.py"
+    missing.write_text("OTHER = frozenset({'ping'})\n")
+    with pytest.raises(ValueError, match="CONTROL_VERBS"):
+        check_wrappers.load_verb_registry(missing)
+
+    computed = tmp_path / "computed_verbs.py"
+    computed.write_text("CONTROL_VERBS = frozenset(sorted({'ping'}))\n")
+    with pytest.raises(ValueError, match="literal"):
+        check_wrappers.load_verb_registry(computed)
+
+    unnamed = tmp_path / "unnamed_verbs.py"
+    unnamed.write_text("CONTROL_VERBS = frozenset({'ping'})\n")
+    with pytest.raises(ValueError, match="constants"):
+        check_wrappers.load_verb_registry(unnamed)
+
+
 def test_accepts_super_delegation(tmp_path):
     ok = tmp_path / "ok_van.py"
     ok.write_text(

@@ -36,6 +36,16 @@ PR 6, nothing enforced:
    (``check_flightrec_calls``; registry parsed by AST via
    ``load_event_registry``, which fails loudly if the literal moves).
 
+5. **CONTROL verbs come from the closed registry.**  Every
+   ``{"cmd": ...}`` payload literal must name a verb from
+   ``core/manager.py``'s ``CONTROL_VERBS`` frozenset — either as one of
+   the module's verb constants (``HEARTBEAT``, ``TELEMETRY``, ...) or as
+   a literal string in the set.  A stringly-typed ``{"cmd": "telemtry"}``
+   typo would otherwise fall through ``Manager.handle_request``'s elif
+   chain and be silently acked as a no-op (``check_control_verbs``;
+   registry parsed by AST via ``load_verb_registry``, same loud-failure
+   stance as the event registry).
+
 Pure-AST check (no imports of the checked modules), so it runs in any
 environment and is wired as a tier-1 test (``tests/test_wrapper_contract.py``).
 Exit code 0 = clean; 1 = violations (one line each).
@@ -72,6 +82,10 @@ _PICKLE_NAMES = frozenset(
 #: module holding the closed event-kind registry (``EVENTS`` frozenset
 #: literal), relative to the package root.
 FLIGHTREC_MODULE = "core/flightrec.py"
+
+#: module holding the closed CONTROL-verb registry (``CONTROL_VERBS``
+#: frozenset literal + the verb constants), relative to the package root.
+MANAGER_MODULE = "core/manager.py"
 
 #: bare-callable names treated as flight-recorder record aliases (the
 #: ``rec = recorder.record or flightrec.record`` pattern in utils/slo.py).
@@ -179,22 +193,23 @@ def check_no_pickle(path: pathlib.Path) -> List[str]:
     return problems
 
 
-def load_event_registry(path: pathlib.Path) -> frozenset:
-    """Extract the ``EVENTS`` frozenset literal from ``core/flightrec.py``.
+def _parse_frozenset_literal(
+    path: pathlib.Path, tree: ast.Module, var: str, moved_hint: str
+) -> frozenset:
+    """Extract a module-level ``<var> = frozenset({...})`` string literal.
 
     Parsed without importing (same stance as the rest of this tool), which
-    is why flightrec.py keeps ``EVENTS = frozenset({"...", ...})`` a plain
-    literal — no comprehension, no concatenation.  Raises ``ValueError``
-    when the assignment is missing, non-literal, or empty: a refactor that
-    moves the registry must break this check loudly, never let every call
-    site pass vacuously against an empty set.
+    is why the registry modules keep their sets plain literals — no
+    comprehension, no concatenation.  Raises ``ValueError`` when the
+    assignment is missing, non-literal, or empty: a refactor that moves a
+    registry must break this check loudly, never let every call site pass
+    vacuously against an empty set.
     """
-    tree = ast.parse(path.read_text(), filename=str(path))
     for node in tree.body:
         if not isinstance(node, ast.Assign):
             continue
         if not any(
-            isinstance(t, ast.Name) and t.id == "EVENTS" for t in node.targets
+            isinstance(t, ast.Name) and t.id == var for t in node.targets
         ):
             continue
         value = node.value
@@ -206,26 +221,72 @@ def load_event_registry(path: pathlib.Path) -> frozenset:
             and isinstance(value.args[0], (ast.Set, ast.List, ast.Tuple))
         ):
             raise ValueError(
-                f"{_rel(path)}:{node.lineno}: EVENTS must be a plain "
+                f"{_rel(path)}:{node.lineno}: {var} must be a plain "
                 "frozenset({...}) literal of string constants (AST-parsed)"
             )
-        kinds = []
+        items = []
         for elt in value.args[0].elts:
             if not (
                 isinstance(elt, ast.Constant) and isinstance(elt.value, str)
             ):
                 raise ValueError(
                     f"{_rel(path)}:{elt.lineno}: non-literal element in "
-                    "EVENTS — every kind must be a plain string constant"
+                    f"{var} — every entry must be a plain string constant"
                 )
-            kinds.append(elt.value)
-        if not kinds:
-            raise ValueError(f"{_rel(path)}: EVENTS registry is empty")
-        return frozenset(kinds)
+            items.append(elt.value)
+        if not items:
+            raise ValueError(f"{_rel(path)}: {var} registry is empty")
+        return frozenset(items)
     raise ValueError(
-        f"{_rel(path)}: no module-level EVENTS assignment found — the "
-        "flight-recorder kind registry moved; update FLIGHTREC_MODULE"
+        f"{_rel(path)}: no module-level {var} assignment found — "
+        f"{moved_hint}"
     )
+
+
+def load_event_registry(path: pathlib.Path) -> frozenset:
+    """Extract the ``EVENTS`` frozenset literal from ``core/flightrec.py``."""
+    tree = ast.parse(path.read_text(), filename=str(path))
+    return _parse_frozenset_literal(
+        path, tree, "EVENTS",
+        "the flight-recorder kind registry moved; update FLIGHTREC_MODULE",
+    )
+
+
+def load_verb_registry(path: pathlib.Path):
+    """Extract ``core/manager.py``'s verb registry.
+
+    Returns ``(verbs, names)``: the ``CONTROL_VERBS`` frozenset literal
+    plus a map of module-level verb constants (``NAME = "literal"``
+    string assignments whose value is in the set) — ``{"HEARTBEAT":
+    "heartbeat", "TELEMETRY": "telemetry", ...}``.  Same loud-failure
+    stance as :func:`load_event_registry`: a moved or computed registry
+    raises ``ValueError`` instead of letting every ``{"cmd": ...}`` site
+    pass vacuously.
+    """
+    tree = ast.parse(path.read_text(), filename=str(path))
+    verbs = _parse_frozenset_literal(
+        path, tree, "CONTROL_VERBS",
+        "the CONTROL-verb registry moved; update MANAGER_MODULE",
+    )
+    names = {}
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        if not (
+            isinstance(node.value, ast.Constant)
+            and isinstance(node.value.value, str)
+            and node.value.value in verbs
+        ):
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                names[t.id] = node.value.value
+    if not names:
+        raise ValueError(
+            f"{_rel(path)}: no verb constants found — CONTROL_VERBS exists "
+            "but no NAME = \"<verb>\" module-level assignments match it"
+        )
+    return verbs, names
 
 
 def _record_kind_arg(call: ast.Call):
@@ -293,6 +354,54 @@ def check_flightrec_calls(path: pathlib.Path, events: frozenset) -> List[str]:
     return problems
 
 
+def check_control_verbs(
+    path: pathlib.Path, verbs: frozenset, names: dict
+) -> List[str]:
+    """Flag ``{"cmd": ...}`` dict literals naming an unregistered verb.
+
+    A value passes when it is a literal string in ``CONTROL_VERBS``, a
+    bare ``Name`` (or dotted ``Attribute`` tail) matching one of the verb
+    constants, and fails otherwise — unknown literal, unknown name, or a
+    computed expression the AST cannot vouch for.  Dynamic routing code
+    that reads ``payload.get("cmd")`` is untouched: only dict DISPLAYS
+    with a literal ``"cmd"`` key are payload-construction sites.
+    """
+    tree = ast.parse(path.read_text(), filename=str(path))
+    problems: List[str] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Dict):
+            continue
+        for key, value in zip(node.keys, node.values):
+            if not (
+                isinstance(key, ast.Constant) and key.value == "cmd"
+            ):
+                continue
+            if isinstance(value, ast.Constant) and isinstance(value.value, str):
+                if value.value in verbs:
+                    continue
+                problems.append(
+                    f"{_rel(path)}:{value.lineno}: cmd literal "
+                    f"{value.value!r} is not in CONTROL_VERBS "
+                    "(core/manager.py) — Manager.handle_request would "
+                    "silently ack it as a no-op; add the verb to the "
+                    "registry or fix the typo"
+                )
+                continue
+            const = None
+            if isinstance(value, ast.Name):
+                const = value.id
+            elif isinstance(value, ast.Attribute):
+                const = value.attr  # manager.TELEMETRY style
+            if const is not None and const in names:
+                continue
+            problems.append(
+                f"{_rel(path)}:{value.lineno}: cmd payload value is not a "
+                "registered verb constant or CONTROL_VERBS literal — verbs "
+                "must be statically checkable (core/manager.py registry)"
+            )
+    return problems
+
+
 def main(argv: List[str]) -> int:
     roots = [pathlib.Path(a) for a in argv[1:]] or [PKG]
     problems: List[str] = []
@@ -303,6 +412,11 @@ def main(argv: List[str]) -> int:
     except (OSError, ValueError) as e:
         print(f"check_wrappers: event registry unreadable: {e}", file=sys.stderr)
         return 1  # a moved/emptied registry must fail loudly, not pass
+    try:
+        verbs, verb_names = load_verb_registry(PKG / MANAGER_MODULE)
+    except (OSError, ValueError) as e:
+        print(f"check_wrappers: verb registry unreadable: {e}", file=sys.stderr)
+        return 1  # same loud-failure stance as the event registry
     for root in roots:
         files = [root] if root.is_file() else sorted(root.rglob("*.py"))
         for f in files:
@@ -314,6 +428,7 @@ def main(argv: List[str]) -> int:
                 found_hot_path += 1
                 problems.extend(check_no_pickle(f))
             problems.extend(check_flightrec_calls(f, events))
+            problems.extend(check_control_verbs(f, verbs, verb_names))
             text = f.read_text()
             if "VanWrapper" not in text:
                 continue

@@ -18,9 +18,20 @@ merge rebases each file's events onto the shared clock — exact for
 in-process clusters (one perf_counter domain), best-effort across OS
 processes (as with any unsynchronized one-way timestamps).
 
+Flight-recorder bundles (``tools/postmortem.py`` input — a JSON document
+with an ``events`` list plus ``wall/mono_anchor_s`` and ``clock_offset_s``)
+are accepted alongside trace files and bridged as Perfetto *instant*
+events (``ph: "i"``), so the black-box journal's ``resend.retransmit`` /
+``slo.breach`` markers land on the same timeline as the spans they
+explain.  Each bundle event's monotonic stamp is rebased into the shared
+scheduler clock domain by subtracting the bundle's ``clock_offset_s``
+(the heartbeat min-RTT estimate), then shifted onto the merge's common
+epoch exactly like span ``ts`` values.
+
 Usage::
 
     python tools/merge_traces.py -o merged.json trace_W0.json trace_S0.json ...
+    python tools/merge_traces.py -o merged.json trace_W0.json flightrec_W0.json
 
 Node names come from each file's ``metadata.node``, else the file stem.
 The output is plain chrome-trace JSON ("traceEvents" array) — open with
@@ -35,16 +46,72 @@ import os
 import sys
 from typing import Dict, List, Optional, Tuple
 
-#: ph values this tool understands (complete spans + metadata).
-_KNOWN_PHASES = {"X", "M"}
+#: ph values this tool understands (complete spans, metadata, instants).
+_KNOWN_PHASES = {"X", "M", "i"}
+
+#: valid instant-event scopes ("g"lobal, "p"rocess, "t"hread).
+_INSTANT_SCOPES = {"g", "p", "t"}
+
+
+def is_bundle(doc: dict) -> bool:
+    """True for a flight-recorder bundle (postmortem.py's input shape)."""
+    return isinstance(doc.get("events"), list) and "traceEvents" not in doc
+
+
+def bundle_to_trace(doc: dict, fallback_node: str) -> Tuple[str, dict]:
+    """Bridge a flight-recorder bundle into a chrome-trace-shaped document.
+
+    Every journal event becomes an instant (``ph: "i"``, process scope)
+    named by its kind, carrying the remaining journal fields in ``args``.
+    The embedded epoch is the bundle's monotonic anchor REBASED into the
+    scheduler clock domain (``mono_anchor_s - clock_offset_s``), and each
+    event's ``ts`` is likewise offset-corrected — so once ``merge_traces``
+    shifts all files onto the earliest epoch, bundle instants from
+    different nodes line up to RTT/2 accuracy, and line up with tracer
+    spans exactly for in-process clusters (one clock domain).
+    """
+    node = str(doc.get("node") or fallback_node)
+    mono = float(doc.get("mono_anchor_s") or 0.0)
+    off = float(doc.get("clock_offset_s") or 0.0)
+    events: List[dict] = []
+    for ev in doc["events"]:
+        if not isinstance(ev, dict):
+            continue
+        t_mono = float(ev.get("t_mono_s") or 0.0)
+        args = {
+            k: v for k, v in ev.items()
+            if k not in ("t_mono_s", "kind")
+        }
+        args.setdefault("node", node)
+        events.append(
+            {
+                "name": str(ev.get("kind") or "event"),
+                "ph": "i",
+                "s": "p",
+                "ts": (t_mono - mono) * 1e6,
+                "tid": 0,
+                "args": args,
+            }
+        )
+    return node, {
+        "traceEvents": events,
+        "metadata": {"node": node, "clock_t0_s": mono - off},
+    }
 
 
 def load_trace(path: str) -> Tuple[str, dict]:
-    """Read one per-node dump; returns (node_name, document)."""
+    """Read one per-node dump; returns (node_name, document).
+
+    Flight-recorder bundles are detected by shape and bridged via
+    :func:`bundle_to_trace`; chrome-trace files pass through unchanged.
+    """
     with open(path) as f:
         doc = json.load(f)
+    stem = os.path.splitext(os.path.basename(path))[0]
+    if is_bundle(doc):
+        return bundle_to_trace(doc, stem)
     meta = doc.get("metadata") or {}
-    node = meta.get("node") or os.path.splitext(os.path.basename(path))[0]
+    node = meta.get("node") or stem
     return str(node), doc
 
 
@@ -102,7 +169,9 @@ def validate_chrome_trace(doc: dict) -> List[str]:
     Returns a list of problems (empty = valid): a ``traceEvents`` array
     where every event has a string ``name`` and known ``ph``; complete
     ("X") events also need numeric ``ts`` + non-negative ``dur`` and
-    integer ``pid``/``tid``.
+    integer ``pid``/``tid``; instants ("i", the bridged flight-recorder
+    events) need numeric ``ts``, integer ``tid``, and a valid scope when
+    ``s`` is present.
     """
     problems: List[str] = []
     events = doc.get("traceEvents")
@@ -129,6 +198,13 @@ def validate_chrome_trace(doc: dict) -> List[str]:
                 problems.append(f"{where}: dur missing/negative")
             if not isinstance(ev.get("tid"), int):
                 problems.append(f"{where}: tid missing or not an int")
+        if ph == "i":
+            if not isinstance(ev.get("ts"), (int, float)):
+                problems.append(f"{where}: ts missing or not numeric")
+            if not isinstance(ev.get("tid"), int):
+                problems.append(f"{where}: tid missing or not an int")
+            if "s" in ev and ev["s"] not in _INSTANT_SCOPES:
+                problems.append(f"{where}: instant scope {ev['s']!r} invalid")
         if "args" in ev and not isinstance(ev["args"], dict):
             problems.append(f"{where}: args not an object")
     return problems
@@ -153,9 +229,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     with open(args.output, "w") as f:
         json.dump(merged, f)
     n_spans = sum(1 for e in merged["traceEvents"] if e.get("ph") == "X")
+    n_inst = sum(1 for e in merged["traceEvents"] if e.get("ph") == "i")
     print(
-        f"merged {len(args.traces)} node traces ({n_spans} spans) "
-        f"-> {args.output}"
+        f"merged {len(args.traces)} node traces ({n_spans} spans, "
+        f"{n_inst} instants) -> {args.output}"
     )
     return 0
 

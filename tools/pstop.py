@@ -1,0 +1,183 @@
+#!/usr/bin/env python
+"""pstop: top-like live console over the scheduler's telemetry ring.
+
+``core/telemetry.py``'s :class:`TelemetryAggregator` appends one derived
+row per ingested TELEMETRY frame to a bounded per-node ring and (when
+constructed with ``jsonl_path=``) spills the same rows to a JSONL file.
+This tool renders that stream as a fleet table — per-node message/byte
+rates, deliver latency, staleness quantiles, straggler flags, SLO
+verdicts, active migrations — refreshed in place like ``top``.
+
+It reads the JSONL spill, so it runs out-of-process against a live
+training job (the writer flushes whole lines only, so a concurrent
+reader never sees a torn row) or after the fact against a saved file::
+
+    python tools/pstop.py traces/telemetry.jsonl            # live, 1 Hz
+    python tools/pstop.py --interval 0.2 traces/telemetry.jsonl
+    python tools/pstop.py --once traces/telemetry.jsonl     # one snapshot
+
+Columns:
+
+- ``SEQ``       last frame sequence number ingested from the node;
+- ``AGE``       seconds since that frame arrived, relative to the newest
+                ingest stamp in the file (exact for live tails);
+- ``MSG/S`` / ``KB/S``  transport rates over the node's originated links;
+- ``P99ms``     inter-frame deliver-latency p99 (this frame's link deltas);
+- ``STALE p50/p99``  worst staleness series (update version-lag, in
+                VERSIONS behind the server, not time) — ``-`` until the
+                node has recorded staleness samples;
+- ``MIG``       active migrations (begin - commit - abort event totals);
+- ``SLO``       ``ok`` / ``BREACH:<spec,...>`` from the live engine;
+- ``FLAGS``     FleetMonitor straggler flags (``latency``, ``gap``).
+
+``render`` is a pure function over ``TelemetryAggregator.latest()``-shaped
+dicts, so tests and in-process callers can use it without a terminal.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Dict, List, Optional
+
+#: ANSI: clear screen + home — the in-place refresh between frames.
+_CLEAR = "\x1b[2J\x1b[H"
+
+_HEADER = (
+    f"{'NODE':<10} {'SEQ':>5} {'AGE':>6} {'MSG/S':>8} {'KB/S':>9} "
+    f"{'P99ms':>8} {'STALE p50/p99':>14} {'MIG':>3} {'SLO':<18} FLAGS"
+)
+
+
+def load_rows(path: str) -> Dict[str, dict]:
+    """Latest row per node from a telemetry JSONL spill.
+
+    Tolerates a torn final line (a reader racing the writer's rotation)
+    by skipping undecodable lines; keeps the row with the highest ``seq``
+    per node so replayed files collapse to current state.
+    """
+    latest: Dict[str, dict] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            node = row.get("node")
+            if not isinstance(node, str):
+                continue
+            have = latest.get(node)
+            if have is None or int(row.get("seq") or 0) >= int(have.get("seq") or 0):
+                latest[node] = row
+    return latest
+
+
+def _worst_staleness(row: dict) -> Optional[dict]:
+    """The staleness series with the highest p99 (the one that matters)."""
+    series = row.get("staleness")
+    if not isinstance(series, dict) or not series:
+        return None
+    return max(
+        (s for s in series.values() if isinstance(s, dict)),
+        key=lambda s: float(s.get("p99") or 0.0),
+        default=None,
+    )
+
+
+def render(latest: Dict[str, dict], now: Optional[float] = None) -> List[str]:
+    """Format the fleet table; returns lines (no trailing newline).
+
+    ``latest`` is ``{node: row}`` as produced by
+    ``TelemetryAggregator.latest()`` or :func:`load_rows`.  ``now`` is the
+    reference for the AGE column, in the same clock domain as the rows'
+    ``t_ingest`` stamps; defaults to the newest stamp present, which makes
+    offline replays show age-at-capture instead of nonsense.
+    """
+    if not latest:
+        return ["(no telemetry rows yet)"]
+    stamps = [
+        float(r.get("t_ingest") or 0.0) for r in latest.values()
+    ]
+    ref = max(stamps) if now is None else now
+    lines = [_HEADER]
+    breached_total = 0
+    for node in sorted(latest):
+        row = latest[node]
+        age = max(ref - float(row.get("t_ingest") or ref), 0.0)
+        msgs = row.get("msgs_per_s")
+        kbs = (
+            float(row["bytes_per_s"]) / 1e3
+            if row.get("bytes_per_s") is not None else None
+        )
+        p99 = row.get("deliver_p99_ms")
+        stale = _worst_staleness(row)
+        stale_s = (
+            f"{stale['p50']:.0f}/{stale['p99']:.0f}" if stale else "-"
+        )
+        mig = row.get("migrations_active") or 0
+        healthy = row.get("healthy")
+        if healthy is None:
+            slo = "-"
+        elif healthy:
+            slo = "ok"
+        else:
+            breaches = row.get("breaches") or []
+            breached_total += 1
+            slo = "BREACH:" + ",".join(breaches) if breaches else "BREACH"
+        flags = ",".join(row.get("straggler") or []) or "-"
+        lines.append(
+            f"{node:<10} {int(row.get('seq') or 0):>5} {age:>5.1f}s "
+            f"{msgs if msgs is not None else '-':>8} "
+            f"{f'{kbs:.1f}' if kbs is not None else '-':>9} "
+            f"{p99 if p99 is not None else '-':>8} {stale_s:>14} "
+            f"{mig:>3} {slo:<18} {flags}"
+        )
+    lines.append(
+        f"-- {len(latest)} nodes, {breached_total} breached; "
+        "staleness in versions, rates per second --"
+    )
+    return lines
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="live fleet console over a telemetry JSONL spill"
+    )
+    ap.add_argument("path", help="telemetry.jsonl written by the aggregator")
+    ap.add_argument(
+        "--interval", type=float, default=1.0,
+        help="refresh period in seconds (default: %(default)s)",
+    )
+    ap.add_argument(
+        "--once", action="store_true",
+        help="print one snapshot and exit (no screen clearing)",
+    )
+    args = ap.parse_args(argv)
+    if args.interval <= 0:
+        print("pstop: --interval must be > 0", file=sys.stderr)
+        return 2
+    while True:
+        try:
+            latest = load_rows(args.path)
+        except OSError as e:
+            print(f"pstop: {e}", file=sys.stderr)
+            return 1
+        lines = render(latest)
+        if args.once:
+            print("\n".join(lines))
+            return 0
+        sys.stdout.write(_CLEAR + "\n".join(lines) + "\n")
+        sys.stdout.flush()
+        try:
+            time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
