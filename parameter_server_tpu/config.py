@@ -88,6 +88,36 @@ class OptimizerConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ApplyEngineConfig:
+    """Server-side apply engine knobs (the bundle-batched push path).
+
+    The engine turns a coalesced bundle of same-table PUSHes into (ideally)
+    one donated-buffer device call instead of one per request.  How
+    duplicate row ids ACROSS bundle members are handled is the semantic
+    knob:
+
+    - ``"rounds"`` (default): members are partitioned into occurrence
+      rounds — round *k* applies the *k*-th contribution each row received,
+      one device call per round.  Because the optimizer is row-wise, this
+      is **bitwise-identical to sequential per-request apply for every
+      optimizer**, duplicates included; with no cross-member duplicates it
+      degenerates to exactly one call.
+    - ``"combine"``: duplicate rows are pre-merged on device with
+      ``segment_combine`` (the reference server's ParallelOrderedMatch
+      merge) and applied once — always one device call.  This sums
+      gradients before the update, the classic PS merge: identical to
+      sequential when members touch disjoint rows, and the standard
+      sum-semantics (not bitwise-sequential) when they overlap.
+    """
+
+    #: max same-table PUSHes concatenated into one batched device apply;
+    #: <= 1 disables bundling (every request applies individually).
+    apply_batch: int = 16
+    #: cross-member duplicate-id policy: "rounds" | "combine" (see above).
+    dup_policy: str = "rounds"
+
+
+@dataclasses.dataclass(frozen=True)
 class TableConfig:
     """A KV table: the unit the reference range-partitions across servers.
 
@@ -114,3 +144,9 @@ class TableConfig:
     #: ops/scatter.py — interpreter-run off TPU so tests exercise the same
     #: code path; dim == 128 or dim % 1024 == 0).
     scatter_impl: str = "auto"
+    #: fused push apply: gather → optimizer step → scatter as ONE pass
+    #: (``ops.scatter.apply_rows``).  Under ``scatter_impl="pallas"`` this
+    #: is a single DMA kernel (one HBM row round-trip instead of three
+    #: kernel groups); under XLA it traces the op-for-op identical graph as
+    #: the legacy three-pass body, so flipping it is bitwise-neutral there.
+    fused_apply: bool = True

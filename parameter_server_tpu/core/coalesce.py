@@ -361,8 +361,19 @@ class CoalescingVan(VanWrapper):
             with self.window():
                 if msg.task.customer != BUNDLE_CUSTOMER:
                     handler(msg)
+                    return
+                subs = _unpack(msg)
+                # grouped delivery: a Postoffice-bound handler takes the
+                # whole bundle at once so batchable customers (the server
+                # apply engine) see their members TOGETHER — one device
+                # apply per same-table push run, one readback per bundle
+                recv_batch = getattr(
+                    getattr(handler, "__self__", None), "recv_batch", None
+                )
+                if recv_batch is not None:
+                    recv_batch(subs)
                 else:
-                    for sub in _unpack(msg):
+                    for sub in subs:
                         handler(sub)
 
         self.inner.bind(node_id, unbundle)

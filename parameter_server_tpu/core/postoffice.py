@@ -101,25 +101,93 @@ class Postoffice:
                 del self._cancelled[(sender, customer)]
             return True
 
+    def _cancel_dropped(self, msg: Message) -> bool:
+        """True iff a cancellation fence matched ``msg`` (now dropped)."""
+        if not self._consume_cancel(
+            msg.sender, msg.task.customer, msg.task.time
+        ):
+            return False
+        self.cancelled_drops += 1
+        flightrec.record(
+            "cancel.drop", node=self.node_id, sender=msg.sender,
+            customer=msg.task.customer, ts=msg.task.time,
+        )
+        logging.getLogger(__name__).info(
+            "%s: dropped cancelled request ts=%s from %s/%s",
+            self.node_id,
+            msg.task.time,
+            msg.sender,
+            msg.task.customer,
+        )
+        return True
+
+    def recv_batch(self, msgs: list[Message]) -> None:
+        """Deliver the members of one unbundled frame together.
+
+        Consecutive requests for a customer that implements
+        ``handle_request_batch`` are handed over as ONE group (the
+        bundle-batched server apply path); everything else — responses,
+        cancels, unknown customers, non-batchable customers — routes
+        through the ordinary per-message :meth:`_on_recv`, in frame order.
+        Cancellation fences are still honoured per member.
+        """
+        i, n = 0, len(msgs)
+        while i < n:
+            msg = msgs[i]
+            customer = (
+                self._customers.get(msg.task.customer)
+                if msg.is_request and msg.task.customer != CANCEL_CUSTOMER
+                else None
+            )
+            if (
+                customer is None
+                or getattr(customer, "handle_request_batch", None) is None
+            ):
+                self._on_recv(msg)
+                i += 1
+                continue
+            j = i
+            live: list[Message] = []
+            while (
+                j < n
+                and msgs[j].is_request
+                and msgs[j].task.customer == msg.task.customer
+            ):
+                if not self._cancel_dropped(msgs[j]):
+                    live.append(msgs[j])
+                j += 1
+            if live:
+                try:
+                    replies = customer.process_request_batch(live)
+                except Exception as e:  # noqa: BLE001
+                    # a batch-level failure must still answer EVERY member,
+                    # or each requester's wait(ts) hangs forever
+                    logging.getLogger(__name__).exception(
+                        "%s: batch handler error (%d msgs) from %s",
+                        self.node_id,
+                        len(live),
+                        msg.sender,
+                    )
+                    replies = []
+                    for m in live:
+                        reply = m.reply()
+                        reply.task = dataclasses.replace(
+                            m.task,
+                            payload={
+                                "__error__": f"{type(e).__name__}: {e}"
+                            },
+                        )
+                        replies.append(reply)
+                for reply in replies:
+                    if reply is not None:
+                        self.van.send(reply)
+            i = j
+
     def _on_recv(self, msg: Message) -> None:
         if msg.is_request and msg.task.customer == CANCEL_CUSTOMER:
             self._on_cancel(msg)
             return  # fire-and-forget: the canceller already finalized
-        if msg.is_request and self._consume_cancel(
-            msg.sender, msg.task.customer, msg.task.time
-        ):
-            self.cancelled_drops += 1
-            flightrec.record(
-                "cancel.drop", node=self.node_id, sender=msg.sender,
-                customer=msg.task.customer, ts=msg.task.time,
-            )
-            logging.getLogger(__name__).info(
-                "%s: dropped cancelled request ts=%s from %s/%s",
-                self.node_id,
-                msg.task.time,
-                msg.sender,
-                msg.task.customer,
-            )
+        if msg.is_request and self._cancel_dropped(msg):
             return
         customer = self._customers.get(msg.task.customer)
         if customer is None:
@@ -401,6 +469,27 @@ class Customer:
             prev = self._executed.get(msg.sender, -1)
             self._executed[msg.sender] = max(prev, msg.task.time)
         return reply
+
+    #: subclasses that can process a frame's requests TOGETHER (one device
+    #: apply per group, one readback per bundle) define this as a method
+    #: ``(msgs) -> [reply|None, ...]``; Postoffice.recv_batch routes grouped
+    #: delivery through it.  ``None`` here = not batchable.
+    handle_request_batch = None
+
+    def process_request_batch(
+        self, msgs: list[Message]
+    ) -> list[Optional[Message]]:
+        """Route a grouped frame through :meth:`handle_request_batch`.
+
+        The handler answers every member itself (per-member errors become
+        ``__error__`` replies inside), so all members count as executed.
+        """
+        replies = self.handle_request_batch(msgs)
+        with self._cond:
+            for m in msgs:
+                prev = self._executed.get(m.sender, -1)
+                self._executed[m.sender] = max(prev, m.task.time)
+        return replies
 
     def handle_request(self, msg: Message) -> Optional[Message]:
         """Override: process a request, return the reply Message (or None)."""

@@ -31,7 +31,14 @@ State = Dict[str, Rows]
 
 
 class ServerOptimizer:
-    """Interface: init per-row state, apply updates, derive pull weights."""
+    """Interface: init per-row state, apply updates, derive pull weights.
+
+    ``apply`` is the ROW-WISE contract the fused apply kernel inlines
+    (``ops.scatter.apply_rows``): a pure elementwise function over
+    ``[n, dim]`` blocks — no cross-row reductions, no data-dependent
+    shapes — so the same trace runs as the update stage of a single-pass
+    gather→apply→scatter Pallas kernel or as plain XLA ops, bit-for-bit.
+    """
 
     name = "base"
     #: True iff apply(value, state, 0) == (value, state) when l1 == l2 == 0.
@@ -45,6 +52,10 @@ class ServerOptimizer:
     def state_shapes(self) -> Dict[str, float]:
         """State array names -> fill value at init."""
         return {}
+
+    def state_names(self) -> tuple[str, ...]:
+        """Deterministic state-plane order (kernel scratch/DMA layout)."""
+        return tuple(sorted(self.state_shapes()))
 
     def apply(self, value: Rows, state: State, grad: Rows) -> tuple[Rows, State]:
         raise NotImplementedError

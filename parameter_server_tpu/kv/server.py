@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import logging
 import time
 import zlib
 from typing import Dict, List, Optional, Tuple
@@ -36,7 +37,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from parameter_server_tpu.config import TableConfig
+from parameter_server_tpu.config import ApplyEngineConfig, TableConfig
 from parameter_server_tpu.core import flightrec
 from parameter_server_tpu.core.messages import Message, Task, TaskKind
 from parameter_server_tpu.core.postoffice import Customer, Postoffice
@@ -77,6 +78,7 @@ class KVServer(Customer):
         replica_ack_timeout: float = 60.0,
         routing: Optional[RoutingTable] = None,
         migrate_timeout: float = 30.0,
+        apply: Optional[ApplyEngineConfig] = None,
     ) -> None:
         """``replica``: node id of a hot-standby KVServer holding the same
         shard (chain replication of key ranges, the reference paper's §4.3
@@ -95,6 +97,15 @@ class KVServer(Customer):
         cluster (``scale_up`` spawns with ZERO owned rows and migrates onto
         it)."""
         super().__init__(name, post)
+        #: bundle-batched apply engine knobs (ISSUE 11): how many same-table
+        #: PUSHes of one coalesced bundle collapse into a single device
+        #: apply, and the cross-member duplicate-row policy.
+        self.apply_cfg = apply or ApplyEngineConfig()
+        if self.apply_cfg.dup_policy not in ("rounds", "combine"):
+            raise ValueError(
+                f"dup_policy must be rounds|combine, "
+                f"got {self.apply_cfg.dup_policy!r}"
+            )
         #: reply to pulls with device arrays instead of host numpy — the
         #: zero-copy mode for in-process (Loopback) planes where worker and
         #: server share the device; cross-host Vans keep numpy replies.
@@ -196,22 +207,37 @@ class KVServer(Customer):
         local = np.where(owned, gids - starts[idx_c] + locs[idx_c], 0)
         return local, owned
 
-    def _localize_request(self, table: str, keys) -> Optional[np.ndarray]:
+    def _localize_request(
+        self, table: str, keys
+    ) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
         """Worker keys (sorted GLOBAL ids, pad == global rows) -> local ids.
 
-        Pads map to this shard's trash row; returns None when any real id is
-        not owned here (the fence trigger).
+        One vectorized pass over the keys produces everything both data
+        paths need: ``(local_ids int32, keys int64, touched_segments)``.
+        The segment indices fall out of the localization's own
+        ``searchsorted`` ranking, so the staleness bump no longer re-ranks
+        the keys (it used to run ``searchsorted`` a second time per
+        request).  Pads map to this shard's trash row; returns None when
+        any real id is not owned here (the fence trigger).
         """
         grows = self.routing.tables[table].rows
         kn = np.asarray(keys, dtype=np.int64)
         out = np.full(kn.shape, self.tables[table].rows, dtype=np.int32)
         real = kn < grows
+        segs = np.empty(0, dtype=np.int64)
         if real.any():
-            local, owned = self._try_localize(table, kn[real])
+            starts, ends, locs = self._shard_maps[table]
+            if starts.size == 0:
+                return None
+            rk = kn[real]
+            idx = np.searchsorted(starts, rk, side="right") - 1
+            idx_c = np.clip(idx, 0, None)
+            owned = (idx >= 0) & (rk >= 0) & (rk < ends[idx_c])
             if not owned.all():
                 return None
-            out[real] = local.astype(np.int32)
-        return out
+            out[real] = (rk - starts[idx_c] + locs[idx_c]).astype(np.int32)
+            segs = np.unique(idx_c)
+        return out, kn, segs
 
     def _fence_reply(self, msg: Message, why: str) -> Message:
         """Typed reject: ``__error__`` + ``__fenced__`` + the CURRENT table.
@@ -237,21 +263,6 @@ class KVServer(Customer):
         return reply
 
     # -- staleness version clock (ISSUE 10) -----------------------------------
-    def _touched_segments(self, table: str, keys) -> np.ndarray:
-        """Indices (into this shard's segment arrays) the request touches.
-
-        Pads (global id >= the table's global rows) touch nothing; un-owned
-        ids cannot reach here (the fence already rejected them).
-        """
-        starts, _, _ = self._shard_maps[table]
-        if starts.size == 0:
-            return np.empty(0, dtype=np.int64)
-        kn = np.asarray(keys, dtype=np.int64)
-        rk = kn[kn < self.routing.tables[table].rows]
-        if rk.size == 0:
-            return np.empty(0, dtype=np.int64)
-        return np.unique(np.searchsorted(starts, rk, side="right") - 1)
-
     def version_max(self, table: str) -> int:
         """Highest segment version of this shard (0 when it owns nothing)."""
         ver = self._seg_versions[table]
@@ -347,33 +358,7 @@ class KVServer(Customer):
         }
 
     # -- request handling -----------------------------------------------------
-    def handle_request(self, msg: Message) -> Message:
-        if msg.task.kind == TaskKind.CONTROL:
-            return self._handle_control(msg)
-        tname = msg.task.payload["table"]
-        table = self.tables[tname]
-        # Routing fence (PR-6): a stamped epoch that disagrees means the
-        # sender routed with a different table generation — reject with the
-        # current table rather than guessing (an id could alias a row this
-        # server owns under EITHER generation; applying would double-count
-        # when the worker retries the reject).  Unstamped requests (replica
-        # forwards, which follow the primary's apply order by construction)
-        # skip the epoch check but still ownership-check.
-        repoch = msg.task.payload.get(ROUTING_EPOCH_KEY)
-        if repoch is not None and repoch != self.routing.epoch:
-            return self._fence_reply(
-                msg,
-                f"routing epoch mismatch: request {repoch} != "
-                f"server {self.routing.epoch}",
-            )
-        ids_np = self._localize_request(tname, msg.keys)
-        if ids_np is None:
-            return self._fence_reply(
-                msg,
-                f"not owner: {self.post.node_id} does not own all of "
-                f"{len(np.asarray(msg.keys))} requested rows of {tname!r} "
-                f"at epoch {self.routing.epoch}",
-            )
+    def _span_attrs(self, msg: Message, tname: str) -> dict:
         # cross-node stitching: echo the worker's trace context onto this
         # handler's spans so merge_traces can pair both ends of the request
         tctx = msg.task.payload.get("__trace__") or {}
@@ -381,6 +366,42 @@ class KVServer(Customer):
         if tctx.get("tid"):
             span_attrs["trace"] = tctx["tid"]
             span_attrs["origin"] = tctx.get("origin")
+        return span_attrs
+
+    def _validate_data_request(self, msg: Message):
+        """Routing fence + localization for a PUSH/PULL.
+
+        Returns a fence-reject ``Message``, or the localized
+        ``(tname, ids_np, kn, segs)`` tuple when the request may proceed.
+
+        Routing fence (PR-6): a stamped epoch that disagrees means the
+        sender routed with a different table generation — reject with the
+        current table rather than guessing (an id could alias a row this
+        server owns under EITHER generation; applying would double-count
+        when the worker retries the reject).  Unstamped requests (replica
+        forwards, which follow the primary's apply order by construction)
+        skip the epoch check but still ownership-check.
+        """
+        tname = msg.task.payload["table"]
+        repoch = msg.task.payload.get(ROUTING_EPOCH_KEY)
+        if repoch is not None and repoch != self.routing.epoch:
+            return self._fence_reply(
+                msg,
+                f"routing epoch mismatch: request {repoch} != "
+                f"server {self.routing.epoch}",
+            )
+        loc = self._localize_request(tname, msg.keys)
+        if loc is None:
+            return self._fence_reply(
+                msg,
+                f"not owner: {self.post.node_id} does not own all of "
+                f"{len(np.asarray(msg.keys))} requested rows of {tname!r} "
+                f"at epoch {self.routing.epoch}",
+            )
+        ids_np, kn, segs = loc
+        return tname, ids_np, kn, segs
+
+    def _pad_ids(self, table: KVTable, ids_np: np.ndarray, b: int) -> np.ndarray:
         # Bucket-pad the slice to a power of two: the worker bucket-pads its
         # unique slots, but the per-server split (Parameter::Slice) produces
         # arbitrary lengths again — without this every distinct length
@@ -388,68 +409,357 @@ class KVServer(Customer):
         # reject unaligned id vectors outright.  Pads route to the trash row
         # with zero gradients (the established PAD contract).
         n = int(ids_np.shape[0])
+        if b == n:
+            return ids_np
+        padded_ids = np.full(b, table.rows, dtype=np.int32)
+        padded_ids[:n] = ids_np
+        return padded_ids
+
+    def _upload_values(self, vals, b: int, n: int) -> jax.Array:
+        if not isinstance(vals, jax.Array):
+            # direct device handoff: the wire value plane (a zero-copy
+            # frombuffer view of the received frame) feeds the device
+            # transfer as-is — no intermediate padded host copy
+            vals = jnp.asarray(np.asarray(vals))
+        if b != n:  # pad on device (exact zeros: bitwise-neutral)
+            zeros = jnp.zeros((b - n,) + vals.shape[1:], vals.dtype)
+            vals = jnp.concatenate([vals, zeros])
+        return vals
+
+    def _stack_planes(
+        self, table: KVTable, group: List[tuple], k: int, bm: int
+    ) -> jax.Array:
+        """Assemble the bundle's ``(k, bm, dim)`` value stack.
+
+        Wire planes (host numpy views of the received frame) pack into ONE
+        pinned host buffer and ride a single H2D transfer — measurably
+        cheaper than k separate uploads plus a device-side ``stack`` (which
+        re-copies the whole bundle through the CPU client).  Device-resident
+        planes (Loopback ``push_device`` traffic) skip the host and stack on
+        device; zero-pads are exact zeros either way, so both routes are
+        bitwise-identical.
+        """
+        if all(not isinstance(m.values[0], jax.Array) for _, m, *_ in group):
+            dim = table.dim
+            buf = np.empty((k, bm, dim), dtype=np.dtype(table.cfg.dtype))
+            for i, (_, m, _, ids_np, _, _) in enumerate(group):
+                n = int(ids_np.shape[0])
+                buf[i, :n] = np.asarray(m.values[0]).reshape(n, dim)
+                if n < bm:  # pads must stay exact zeros (bitwise-neutral)
+                    buf[i, n:] = 0.0
+            return jnp.asarray(buf)
+        planes = []
+        for _, m, _, ids_np, _, _ in group:
+            n = int(ids_np.shape[0])
+            planes.append(self._upload_values(m.values[0], bm, n))
+        return jnp.stack(planes)
+
+    def _handle_push_single(
+        self,
+        msg: Message,
+        tname: str,
+        ids_np: np.ndarray,
+        kn: np.ndarray,
+        segs: np.ndarray,
+    ) -> Message:
+        table = self.tables[tname]
+        n = int(ids_np.shape[0])
         b = _bucket(n)
-        if b != n:
-            padded_ids = np.full(b, table.rows, dtype=np.int32)
-            padded_ids[:n] = ids_np
-            ids_np = padded_ids
-        ids = jnp.asarray(ids_np)
+        ids = jnp.asarray(self._pad_ids(table, ids_np, b))
+        vals = self._upload_values(msg.values[0], b, n)
+        with self.tracer.span("kv.server.push", **self._span_attrs(msg, tname)):
+            table.push(ids, vals)
+        return self._ack_push(msg, tname, kn, segs)
+
+    def _ack_push(
+        self, msg: Message, tname: str, kn: np.ndarray, segs: np.ndarray
+    ) -> Message:
+        """Post-dispatch bookkeeping + ack: the SYNC-FREE tail of every push.
+
+        Runs after the device apply is dispatched but makes no attempt to
+        observe its result — no ``np.asarray``/``device_get``/
+        ``block_until_ready`` may appear here (``tools/check_wrappers.py``
+        enforces this by AST), so the worker's ack latency is host-side
+        bookkeeping only, never device-apply latency.  (``_forward_push``
+        is host-side wire I/O on pre-upload planes; in ``replica_sync``
+        mode it deliberately blocks on the CHAIN ack, not on device work.)
+        """
+        self.pushes += 1
+        # staleness clock: every apply bumps the touched segments; the
+        # ack carries the post-bump max so the pusher's next pulls can
+        # be measured against a version it knows it contributed to
+        ver = self._seg_versions[tname]
+        if segs.size:
+            ver[segs] += 1
+            sver = int(ver[segs].max())
+        else:
+            sver = self.version_max(tname)
+        if self._migrations:
+            # dirty tracking: rows in a migrating range changed after
+            # their chunk may have shipped — the commit delta re-sends
+            # them, bounding the freeze to exactly this set
+            for m in self._migrations.values():
+                if m["table"] == tname:
+                    hit = kn[(kn >= m["lo"]) & (kn < m["hi"])]
+                    m["dirty"].update(int(x) for x in hit)
+        if self.replica is not None:
+            # forward AFTER the local apply, in apply order (this recv
+            # thread is the only writer), so the standby replays the
+            # identical update sequence
+            self._forward_push(tname, msg)
+        return self._stamp_version(msg, msg.reply(), sver)
+
+    def _pull_device(
+        self, msg: Message, tname: str, ids_np: np.ndarray, segs: np.ndarray
+    ) -> Tuple[jax.Array, int, int]:
+        """Dispatch the device gather; D2H is the CALLER's choice (the
+        bundle path defers it to one transfer per bundle)."""
+        table = self.tables[tname]
+        n = int(ids_np.shape[0])
+        b = _bucket(n)
+        ids = jnp.asarray(self._pad_ids(table, ids_np, b))
+        with self.tracer.span("kv.server.pull", **self._span_attrs(msg, tname)):
+            rows = table.pull(ids)
+        self.pulls += 1
+        # staleness clock: the reply carries the current version of the
+        # touched segments (read, not bumped) — what the worker computes
+        # on is exactly this version of those ranges
+        ver = self._seg_versions[tname]
+        sver = int(ver[segs].max()) if segs.size else self.version_max(tname)
+        return rows, n, sver
+
+    def handle_request(self, msg: Message) -> Message:
+        if msg.task.kind == TaskKind.CONTROL:
+            return self._handle_control(msg)
+        v = self._validate_data_request(msg)
+        if isinstance(v, Message):
+            return v
+        tname, ids_np, kn, segs = v
         if msg.task.kind == TaskKind.PUSH:
-            vals = msg.values[0]
-            if not isinstance(vals, jax.Array):
-                # direct device handoff: the wire value plane (a zero-copy
-                # frombuffer view of the received frame) feeds the device
-                # transfer as-is — no intermediate padded host copy
-                vals = jnp.asarray(np.asarray(vals))
-            if b != n:  # pad on device (exact zeros: bitwise-neutral)
-                zeros = jnp.zeros((b - n,) + vals.shape[1:], vals.dtype)
-                vals = jnp.concatenate([vals, zeros])
-            with self.tracer.span("kv.server.push", **span_attrs):
-                table.push(ids, vals)
-            self.pushes += 1
-            # staleness clock: every apply bumps the touched segments; the
-            # ack carries the post-bump max so the pusher's next pulls can
-            # be measured against a version it knows it contributed to
-            segs = self._touched_segments(tname, msg.keys)
-            ver = self._seg_versions[tname]
-            if segs.size:
-                ver[segs] += 1
-                sver = int(ver[segs].max())
-            else:
-                sver = self.version_max(tname)
-            if self._migrations:
-                # dirty tracking: rows in a migrating range changed after
-                # their chunk may have shipped — the commit delta re-sends
-                # them, bounding the freeze to exactly this set
-                kn = np.asarray(msg.keys, dtype=np.int64)
-                for m in self._migrations.values():
-                    if m["table"] == tname:
-                        hit = kn[(kn >= m["lo"]) & (kn < m["hi"])]
-                        m["dirty"].update(int(x) for x in hit)
-            if self.replica is not None:
-                # forward AFTER the local apply, in apply order (this recv
-                # thread is the only writer), so the standby replays the
-                # identical update sequence
-                self._forward_push(tname, msg)
-            return self._stamp_version(msg, msg.reply(), sver)
+            return self._handle_push_single(msg, tname, ids_np, kn, segs)
         elif msg.task.kind == TaskKind.PULL:
-            with self.tracer.span("kv.server.pull", **span_attrs):
-                rows = table.pull(ids)
-            self.pulls += 1
-            # staleness clock: the reply carries the current version of the
-            # touched segments (read, not bumped) — what the worker computes
-            # on is exactly this version of those ranges
-            segs = self._touched_segments(tname, msg.keys)
-            ver = self._seg_versions[tname]
-            sver = (
-                int(ver[segs].max()) if segs.size else self.version_max(tname)
-            )
+            rows, n, sver = self._pull_device(msg, tname, ids_np, segs)
             if self.device_replies:
                 return self._stamp_version(msg, msg.reply(values=[rows[:n]]), sver)
             return self._stamp_version(
                 msg, msg.reply(values=[np.asarray(rows)[:n]]), sver
             )
         raise ValueError(f"unsupported task kind {msg.task.kind}")
+
+    # -- bundle-batched apply engine (ISSUE 11) -------------------------------
+    def _error_reply(self, msg: Message, exc: Exception) -> Message:
+        """Per-member failure reply, same shape the Postoffice emits for a
+        raising single-request handler."""
+        reply = msg.reply()
+        reply.task = dataclasses.replace(
+            msg.task, payload={"__error__": f"{type(exc).__name__}: {exc}"}
+        )
+        return reply
+
+    def handle_request_batch(self, msgs: List[Message]) -> List[Message]:
+        """Bundle-batched request handling (the fused apply engine).
+
+        A coalesced frame's members arrive together; this path preserves
+        their sequential semantics while collapsing the device traffic:
+
+        - consecutive same-table PUSHes (up to ``apply.apply_batch``) become
+          ONE donated-buffer device apply (``_apply_push_group``) instead of
+          one jit call per member;
+        - every PULL's D2H readback is deferred so the whole bundle costs a
+          single ``jax.device_get`` (none at all under ``device_replies``).
+
+        A PULL, CONTROL, fence, or table switch flushes the open PUSH run
+        first, so each member still observes exactly the writes that
+        preceded it in bundle order.  Failures are isolated per member (the
+        failing member answers ``__error__``; the rest of the bundle
+        proceeds), except that a grouped device apply fails its whole group
+        — the group is one device call by design.
+        """
+        replies: List[Optional[Message]] = [None] * len(msgs)
+        pulls: List[tuple] = []  # (i, msg, rows, n, sver)
+        group: List[tuple] = []  # (i, msg, tname, ids_np, kn, segs)
+
+        def flush_group() -> None:
+            if not group:
+                return
+            try:
+                if len(group) == 1:
+                    i, m, tname, ids_np, kn, segs = group[0]
+                    replies[i] = self._handle_push_single(
+                        m, tname, ids_np, kn, segs
+                    )
+                else:
+                    self._apply_push_group(group, replies)
+            except Exception as e:  # noqa: BLE001
+                logging.getLogger(__name__).exception(
+                    "%s: batched push apply failed (%d members)",
+                    self.post.node_id,
+                    len(group),
+                )
+                for i, m, *_ in group:
+                    replies[i] = self._error_reply(m, e)
+            group.clear()
+
+        batch_cap = max(1, self.apply_cfg.apply_batch)
+        for i, msg in enumerate(msgs):
+            try:
+                if msg.task.kind == TaskKind.CONTROL:
+                    flush_group()
+                    replies[i] = self._handle_control(msg)
+                    continue
+                v = self._validate_data_request(msg)
+                if isinstance(v, Message):
+                    flush_group()  # the fence observes prior writes too
+                    replies[i] = v
+                    continue
+                tname, ids_np, kn, segs = v
+                if msg.task.kind == TaskKind.PUSH:
+                    if group and (
+                        group[0][2] != tname or len(group) >= batch_cap
+                    ):
+                        flush_group()
+                    group.append((i, msg, tname, ids_np, kn, segs))
+                elif msg.task.kind == TaskKind.PULL:
+                    flush_group()  # the pull must see prior member pushes
+                    rows, n, sver = self._pull_device(msg, tname, ids_np, segs)
+                    pulls.append((i, msg, rows, n, sver))
+                else:
+                    raise ValueError(
+                        f"unsupported task kind {msg.task.kind}"
+                    )
+            except Exception as e:  # noqa: BLE001
+                logging.getLogger(__name__).exception(
+                    "%s: handler error for %s from %s",
+                    self.post.node_id,
+                    msg.task.kind,
+                    msg.sender,
+                )
+                replies[i] = self._error_reply(msg, e)
+        flush_group()
+        self._finish_pulls(pulls, replies)
+        return replies
+
+    def _finish_pulls(self, pulls: List[tuple], replies: List) -> None:
+        """Materialize deferred pull replies: ONE host readback per bundle
+        (zero under ``device_replies`` — the rows stay on device)."""
+        if not pulls:
+            return
+        if self.device_replies:
+            for i, m, rows, n, sver in pulls:
+                replies[i] = self._stamp_version(
+                    m, m.reply(values=[rows[:n]]), sver
+                )
+            return
+        host = jax.device_get([rows for _, _, rows, _, _ in pulls])
+        for (i, m, _, n, sver), h in zip(pulls, host):
+            replies[i] = self._stamp_version(m, m.reply(values=[h[:n]]), sver)
+
+    def _apply_push_group(self, group: List[tuple], replies: List) -> None:
+        """One device apply for a run of same-table PUSHes.
+
+        Member value planes upload as-is and zero-pad ON DEVICE to the
+        common bucket ``bm`` (stack shape ``(k, bm, dim)``), so the jitted
+        apply's compile-cache keys stay bucketed: ``(k, bm)`` pairs, never
+        raw wire lengths.  Duplicate rows ACROSS members follow
+        ``apply.dup_policy`` — occurrence ``"rounds"`` (bitwise-sequential)
+        or device ``segment_combine`` (``"combine"``, classic PS sum).
+        Bookkeeping (staleness bumps, dirty tracking, replica forwarding,
+        acks) then runs per member in member order, exactly as sequential
+        handling would have.
+        """
+        tname = group[0][2]
+        table = self.tables[tname]
+        k = len(group)
+        bm = _bucket(max(int(g[3].shape[0]) for g in group))
+        with self.tracer.span(
+            "kv.server.push_batch", table=tname, members=k
+        ):
+            stack = self._stack_planes(table, group, k, bm)
+            # flat positions of every REAL id occurrence, in member order
+            ids_list = [g[3] for g in group]
+            all_ids = np.concatenate(ids_list).astype(np.int64)
+            flat_pos = np.concatenate(
+                [
+                    i * bm + np.arange(a.shape[0], dtype=np.int32)
+                    for i, a in enumerate(ids_list)
+                ]
+            ).astype(np.int32)
+            real = all_ids != table.rows
+            rid = all_ids[real]
+            rpos = flat_pos[real]
+            if self.apply_cfg.dup_policy == "combine":
+                self._push_group_combined(table, k, bm, rid, rpos, stack)
+            else:
+                self._push_group_rounds(table, k, bm, rid, rpos, stack)
+        for i, m, tname_, _, kn, segs in group:
+            replies[i] = self._ack_push(m, tname_, kn, segs)
+
+    def _push_group_rounds(
+        self,
+        table: KVTable,
+        k: int,
+        bm: int,
+        rid: np.ndarray,
+        rpos: np.ndarray,
+        stack: jax.Array,
+    ) -> None:
+        """Occurrence-round partitioning: round ``t`` applies each row's
+        ``t``-th contribution in member order.  Row updates are independent
+        and the optimizer is row-wise, so the per-row grad sequence — and
+        therefore the result — is bitwise-identical to sequential
+        per-member applies, for EVERY optimizer.  With no cross-member
+        duplicates (the common case) this is exactly one device call."""
+        pad_pos = k * bm  # the appended zero row
+        if rid.size == 0:
+            rounds = [(rid, rpos)]
+        else:
+            order = np.argsort(rid, kind="stable")
+            sid = rid[order]
+            spos = rpos[order]
+            newgrp = np.empty(sid.shape, dtype=bool)
+            newgrp[0] = True
+            newgrp[1:] = sid[1:] != sid[:-1]
+            ar = np.arange(sid.size, dtype=np.int64)
+            grp_start = np.maximum.accumulate(np.where(newgrp, ar, 0))
+            occ = ar - grp_start
+            rounds = [
+                (sid[occ == t], spos[occ == t])
+                for t in range(int(occ.max()) + 1)
+            ]
+        for uids_t, pos_t in rounds:
+            nt = int(uids_t.size)
+            bu = _bucket(nt)
+            ids_np = np.full(bu, table.rows, dtype=np.int32)
+            ids_np[:nt] = uids_t.astype(np.int32)
+            pos_np = np.full(bu, pad_pos, dtype=np.int32)
+            pos_np[:nt] = pos_t
+            table.push_batch(jnp.asarray(ids_np), jnp.asarray(pos_np), stack)
+
+    def _push_group_combined(
+        self,
+        table: KVTable,
+        k: int,
+        bm: int,
+        rid: np.ndarray,
+        rpos: np.ndarray,
+        stack: jax.Array,
+    ) -> None:
+        """Device pre-merge: duplicate rows across members segment-sum into
+        one gradient row (the reference's ParallelOrderedMatch merge), then
+        ONE apply — classic PS sum semantics (sequential-identical only for
+        disjoint member rows)."""
+        uids, inv_real = np.unique(rid, return_inverse=True)
+        nu = int(uids.size)
+        bu = _bucket(nu)
+        if bu == nu and nu < k * bm:
+            # every slot holds a real row but pad positions still need a
+            # trash slot to sum (exact zeros) into — grow one bucket
+            bu = _bucket(nu + 1)
+        ids_np = np.full(bu, table.rows, dtype=np.int32)
+        ids_np[:nu] = uids.astype(np.int32)
+        inverse = np.full(k * bm, min(nu, bu - 1), dtype=np.int32)
+        inverse[rpos] = inv_real.astype(np.int32)
+        table.push_combined(jnp.asarray(ids_np), jnp.asarray(inverse), stack)
 
     # -- shard transfer (same-id restart: kv/replica.restart_same_id) --------
     def export_shard(self) -> Dict[str, dict]:

@@ -63,25 +63,45 @@ class KVTable:
                 f"scatter_impl must be auto|xla|pallas, got {cfg.scatter_impl!r}"
             )
         self.scatter_impl = cfg.scatter_impl
+        self.fused_apply = cfg.fused_apply
         self._interpret = (
             cfg.scatter_impl == "pallas" and jax.default_backend() != "tpu"
         )
         self._push_fn = jax.jit(self._push_impl, donate_argnums=(0, 1))
         self._pull_fn = jax.jit(self._pull_impl)
+        self._push_batch_fn = jax.jit(
+            self._push_batch_impl, donate_argnums=(0, 1)
+        )
+        self._push_combined_fn = jax.jit(
+            self._push_combined_impl, donate_argnums=(0, 1)
+        )
 
     def _kern(self, fn, *args):
         return fn(*args, impl=self.scatter_impl, interpret=self._interpret)
 
     # -- jitted bodies ------------------------------------------------------
-    def _push_impl(self, value, state, ids, combined):
-        v_rows = self._kern(scatter.gather_rows, value, ids)
-        s_rows = {k: self._kern(scatter.gather_rows, v, ids) for k, v in state.items()}
-        new_v, new_s = self.optimizer.apply(v_rows, s_rows, combined)
-        value = self._kern(scatter.scatter_update_rows, value, ids, new_v)
-        state = {
-            k: self._kern(scatter.scatter_update_rows, state[k], ids, new_s[k])
-            for k in state
-        }
+    def _apply_core(self, value, state, ids, grads):
+        """Apply ``grads`` at unique ``ids``: fused or three-pass, then the
+        trash-row reset (shared by every push entry point)."""
+        if self.fused_apply:
+            value, state = scatter.apply_rows(
+                value, state, ids, grads, self.optimizer.apply,
+                impl=self.scatter_impl, interpret=self._interpret,
+            )
+        else:
+            v_rows = self._kern(scatter.gather_rows, value, ids)
+            s_rows = {
+                k: self._kern(scatter.gather_rows, v, ids)
+                for k, v in state.items()
+            }
+            new_v, new_s = self.optimizer.apply(v_rows, s_rows, grads)
+            value = self._kern(scatter.scatter_update_rows, value, ids, new_v)
+            state = {
+                k: self._kern(
+                    scatter.scatter_update_rows, state[k], ids, new_s[k]
+                )
+                for k in state
+            }
         # Re-zero the trash row: PAD_KEY positions in real (variable-nnz)
         # batches legitimately route gradients here; resetting keeps pulls of
         # padded positions exactly zero and makes duplicate-trash-id scatters
@@ -90,6 +110,25 @@ class KVTable:
         fills = self.optimizer.state_shapes()
         state = {k: state[k].at[-1].set(fills[k]) for k in state}
         return value, state
+
+    def _push_impl(self, value, state, ids, combined):
+        return self._apply_core(value, state, ids, combined)
+
+    def _push_batch_impl(self, value, state, ids, positions, vals):
+        # vals: (k, bm, dim) member stack; positions index its flattening,
+        # with pads pointing at the appended zero row — the device-side
+        # bucket pad (no host value copies, exact zeros: bitwise-neutral).
+        flat = vals.reshape(-1, vals.shape[-1])
+        flat = jnp.concatenate([flat, jnp.zeros((1,) + flat.shape[1:], flat.dtype)])
+        return self._apply_core(value, state, ids, flat[positions])
+
+    def _push_combined_impl(self, value, state, ids, inverse, vals):
+        # segment_combine pre-merges duplicate rows across bundle members on
+        # device; slots past the unique count only ever receive pad/trash
+        # positions, whose values are exact zeros.
+        flat = vals.reshape(-1, vals.shape[-1])
+        combined = scatter.segment_combine(flat, inverse, ids.shape[0])
+        return self._apply_core(value, state, ids, combined)
 
     def _pull_impl(self, value, state, ids):
         v_rows = self._kern(scatter.gather_rows, value, ids)
@@ -105,6 +144,26 @@ class KVTable:
         """
         self.value, self.state = self._push_fn(
             self.value, self.state, ids, combined_grads
+        )
+
+    def push_batch(
+        self, ids: jax.Array, positions: jax.Array, vals: jax.Array
+    ) -> None:
+        """One bundled apply round: unique ``ids`` gather their gradient rows
+        out of the stacked member values by ``positions`` (pad positions index
+        the appended zero row).  Donated in-place update, one jit call."""
+        self.value, self.state = self._push_batch_fn(
+            self.value, self.state, ids, positions, vals
+        )
+
+    def push_combined(
+        self, ids: jax.Array, inverse: jax.Array, vals: jax.Array
+    ) -> None:
+        """Bundled apply with device pre-combine: every stacked value row is
+        segment-summed into its unique-id slot (``inverse``), then applied in
+        one donated jit call — the ``dup_policy="combine"`` engine mode."""
+        self.value, self.state = self._push_combined_fn(
+            self.value, self.state, ids, inverse, vals
         )
 
     def combine(self, inverse: jax.Array, values: jax.Array, num_rows: int) -> jax.Array:

@@ -28,7 +28,7 @@ The duplicate-key pre-combine that the reference does inside
 from __future__ import annotations
 
 import functools
-from typing import Literal
+from typing import Callable, Dict, Literal, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -36,6 +36,27 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 Impl = Literal["auto", "xla", "pallas"]
+
+
+def _side_effect_params():
+    """``compiler_params`` marking the kernel side-effecting, across the
+    pallas API rename: new toolchains expose ``pltpu.CompilerParams``, jax
+    0.4.x ships ``TPUCompilerParams`` without a ``has_side_effects`` field
+    (aliased outputs are kept live there by ``input_output_aliases``, so
+    omitting the flag is safe — results are always consumed)."""
+    cp = getattr(pltpu, "CompilerParams", None)
+    if cp is not None:
+        return cp(has_side_effects=True)
+    return None
+
+#: row-wise update rule: (value_rows, state_rows, grad_rows) ->
+#: (new_value_rows, new_state_rows).  ServerOptimizer.apply satisfies this
+#: contract directly — pure, elementwise over [n, dim] blocks — which is what
+#: lets :func:`apply_rows` inline it into a single gather→apply→scatter pass.
+RowFn = Callable[
+    [jax.Array, Dict[str, jax.Array], jax.Array],
+    Tuple[jax.Array, Dict[str, jax.Array]],
+]
 
 
 def segment_combine(values: jax.Array, inverse: jax.Array, num_rows: int) -> jax.Array:
@@ -278,7 +299,7 @@ def _pallas_scatter_add(
         out_shape=jax.ShapeDtypeStruct(tview.shape, table.dtype),
         input_output_aliases={2: 0},  # table (arg idx incl. scalar prefetch) -> out
         interpret=interpret,
-        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        compiler_params=_side_effect_params(),
     )(ids, rview, tview)
     return out.reshape(table.shape) if c > 1 else out
 
@@ -332,9 +353,171 @@ def _pallas_scatter_set(
         out_shape=jax.ShapeDtypeStruct(tview.shape, table.dtype),
         input_output_aliases={2: 0},
         interpret=interpret,
-        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        compiler_params=_side_effect_params(),
     )(ids, rview, tview)
     return out.reshape(table.shape) if c > 1 else out
+
+
+def _apply_rows_xla(
+    value: jax.Array,
+    state: Dict[str, jax.Array],
+    ids: jax.Array,
+    grads: jax.Array,
+    row_fn: RowFn,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Gather → row_fn → scatter-update, expressed as one XLA graph.
+
+    Op-for-op identical to the legacy three-pass body of
+    ``KVTable._push_impl`` (same gathers, same elementwise update, same
+    ``.at[].set`` write-backs), so switching a table between fused and
+    three-pass mode is bitwise-neutral on the XLA backends.
+    """
+    v_rows = gather_rows_xla(value, ids)
+    s_rows = {k: gather_rows_xla(v, ids) for k, v in state.items()}
+    new_v, new_s = row_fn(v_rows, s_rows, grads)
+    value = scatter_update_rows_xla(value, ids, new_v)
+    state = {
+        k: scatter_update_rows_xla(state[k], ids, new_s[k]) for k in state
+    }
+    return value, state
+
+
+def _apply_kernel(ids_ref, grads_ref, *refs, block, c, names, row_fn, dim):
+    """Single-pass gather → optimizer step → scatter over value + S states.
+
+    ``refs`` layout (S = len(names)): ``1 + S`` table inputs (HBM, aliased
+    to the outputs, so all DMA goes through the output refs), ``1 + S``
+    output refs, ``1 + S`` VMEM scratch buffers (2 slots each), then the
+    read/write DMA semaphore arrays (shape ``(2, 1 + S, block)``).
+
+    Double-buffered exactly like ``_scatter_add_kernel``: block *i*'s
+    compute + write-back overlaps block *i+1*'s row prefetch.  Unique row
+    ids keep the overlap race-free for real rows.  The shared trash row is
+    the one exception — unlike scatter-add's ``+0`` (bytes unchanged), a
+    state rule may rewrite trash bytes (e.g. Adam's per-row ``t``), so
+    concurrent trash prefetch/write-back can race.  That nondeterminism is
+    confined to the trash row, which the table layer re-zeros immediately
+    after every apply — the visible table state stays deterministic.
+    """
+    ns = 1 + len(names)
+    tabs = refs[ns : 2 * ns]  # output refs (alias the input tables)
+    scratch = refs[2 * ns : 3 * ns]
+    rsems, wsems = refs[3 * ns], refs[3 * ns + 1]
+    i = pl.program_id(0)
+    nb = pl.num_programs(0)
+    slot = i % 2
+    nxt = (i + 1) % 2
+
+    def rows(tab_j, row, scr_j, k, sems, slot_k):
+        return _copy_rows(tabs[tab_j], row, scratch[scr_j].at[slot_k], k,
+                          sems.at[slot_k, tab_j, k], c)
+
+    def back(tab_j, row, scr_j, k, sems, slot_k):
+        return _copy_rows(scratch[scr_j].at[slot_k], k, tabs[tab_j], row,
+                          sems.at[slot_k, tab_j, k], c)
+
+    @pl.when(i == 0)
+    def _first_reads():
+        for k in range(block):
+            row = ids_ref[k]
+            for j in range(ns):
+                rows(j, row, j, k, rsems, 0).start()
+
+    @pl.when(i > 0)
+    def _drain_prev_writes():
+        for k in range(block):
+            row = ids_ref[(i - 1) * block + k]
+            for j in range(ns):
+                back(j, row, j, k, wsems, nxt).wait()
+
+    @pl.when(i + 1 < nb)
+    def _prefetch_next():
+        for k in range(block):
+            row = ids_ref[(i + 1) * block + k]
+            for j in range(ns):
+                rows(j, row, j, k, rsems, nxt).start()
+
+    for k in range(block):
+        row = ids_ref[i * block + k]
+        for j in range(ns):
+            rows(j, row, j, k, rsems, slot).wait()
+    v = scratch[0][slot].reshape(block, dim)
+    s = {
+        name: scratch[1 + j][slot].reshape(block, dim)
+        for j, name in enumerate(names)
+    }
+    g = grads_ref[...].reshape(block, dim)
+    new_v, new_s = row_fn(v, s, g)
+    scratch[0][slot] = new_v.reshape(scratch[0].shape[1:])
+    for j, name in enumerate(names):
+        scratch[1 + j][slot] = new_s[name].reshape(scratch[1 + j].shape[1:])
+    for k in range(block):
+        row = ids_ref[i * block + k]
+        for j in range(ns):
+            back(j, row, j, k, wsems, slot).start()
+
+    @pl.when(i + 1 == nb)
+    def _drain_last_writes():
+        for k in range(block):
+            row = ids_ref[i * block + k]
+            for j in range(ns):
+                back(j, row, j, k, wsems, slot).wait()
+
+
+def _pallas_apply(
+    value: jax.Array,
+    state: Dict[str, jax.Array],
+    ids: jax.Array,
+    grads: jax.Array,
+    row_fn: RowFn,
+    *,
+    interpret: bool,
+    block_rows: int | None = None,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    _check_pallas_args(value, ids)
+    n = ids.shape[0]
+    block = _pick_block_rows(n, block_rows)
+    dim = value.shape[1]
+    c = _chunks(dim)
+    names = tuple(sorted(state))
+    ns = 1 + len(names)
+    vdim = 128 if c > 1 else dim
+    views = [value] + [state[k] for k in names]
+    if c > 1:
+        views = [t.reshape(-1, 128) for t in views]
+        grads = grads.reshape(-1, 128)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n // block,),
+        in_specs=[
+            pl.BlockSpec(
+                (block * c, vdim), lambda i, ids: (i, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ]
+        + [pl.BlockSpec(memory_space=pl.ANY)] * ns,
+        out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * ns,
+        scratch_shapes=[pltpu.VMEM((2, block * c, vdim), value.dtype)] * ns
+        + [
+            pltpu.SemaphoreType.DMA((2, ns, block)),
+            pltpu.SemaphoreType.DMA((2, ns, block)),
+        ],
+    )
+    outs = pl.pallas_call(
+        functools.partial(
+            _apply_kernel, block=block, c=c, names=names, row_fn=row_fn,
+            dim=dim,
+        ),
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct(t.shape, t.dtype) for t in views],
+        # table j rides at arg 2 + j (after scalar-prefetch ids and grads)
+        input_output_aliases={2 + j: j for j in range(ns)},
+        interpret=interpret,
+        compiler_params=_side_effect_params(),
+    )(ids, grads, *views)
+    if c > 1:
+        outs = [o.reshape(value.shape) for o in outs]
+    return outs[0], {k: outs[1 + j] for j, k in enumerate(names)}
 
 
 # ---------------------------------------------------------------------------
@@ -405,6 +588,35 @@ def scatter_update_rows(
         return scatter_update_rows_xla(table, ids, rows)
     return _pallas_scatter_set(
         table, ids, rows, interpret=interpret, block_rows=block_rows
+    )
+
+
+def apply_rows(
+    value: jax.Array,
+    state: Dict[str, jax.Array],
+    ids: jax.Array,
+    grads: jax.Array,
+    row_fn: RowFn,
+    *,
+    impl: Impl = "auto",
+    interpret: bool = False,
+    block_rows: int | None = None,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Fused push apply: gather → ``row_fn`` → scatter-update in one pass.
+
+    Replaces the three kernel groups of the legacy push body (``1 + S``
+    gathers, the update, ``1 + S`` scatter-sets) with a single traversal of
+    the touched rows.  ``ids`` must be unique real rows (duplicates
+    pre-combined; pads all point at the shared trash row, which the caller
+    re-zeros).  The pallas path DMAs value + state rows through VMEM once,
+    runs ``row_fn`` on the resident block, and writes straight back —
+    double-buffered, tables never materialize in VMEM.
+    """
+    if impl != "pallas":
+        return _apply_rows_xla(value, state, ids, grads, row_fn)
+    return _pallas_apply(
+        value, state, ids, grads, row_fn,
+        interpret=interpret, block_rows=block_rows,
     )
 
 

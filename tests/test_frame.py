@@ -441,6 +441,105 @@ def test_non_int_stamp_values_ride_meta_not_header():
     }
 
 
+# ------------------------------------------- control-frame fast path
+
+
+def _slow_encode(msg):
+    """Force the general encoder (the fast path's ground truth)."""
+    orig = frame._fast_encode
+    frame._fast_encode = lambda m: None
+    try:
+        return frame.encode(msg)
+    finally:
+        frame._fast_encode = orig
+
+
+def _ctl(payload, *, kind=TaskKind.CONTROL, is_request=False, time=0):
+    return _msg(
+        task=Task(kind, "t", time=time, payload=payload),
+        keys=None,
+        values=[],
+        is_request=is_request,
+    )
+
+
+_FAST_ELIGIBLE = [
+    _ctl({}),  # bare ack
+    _ctl({resender_mod.SEQ_KEY: 7}),  # the resender ACK shape
+    _ctl(
+        {
+            resender_mod.SEQ_KEY: 7,
+            INCARNATION_KEY: 2,
+            routing_mod.ROUTING_EPOCH_KEY: 5,
+            resender_mod.CRC_KEY: 123456,
+        }
+    ),
+    _ctl({"rows": 42, "step": -3}, kind=TaskKind.PUSH, is_request=True),
+    _ctl({"n": (1 << 63) - 1, "m": -(1 << 63)}, time=-12345),  # i64 edges
+]
+
+
+def test_fast_path_is_byte_identical_to_general_encoder():
+    """Every eligible no-plane control frame must encode to EXACTLY the
+    general path's bytes — receivers (CRC checks, dedup peeks, goldens)
+    can never tell which encoder ran."""
+    for msg in _FAST_ELIGIBLE:
+        fast = frame.encode(msg)
+        assert frame._fast_encode(msg) is not None  # it really ran fast
+        assert fast == _slow_encode(msg)
+        _assert_messages_equal(frame.decode(fast), msg)
+
+
+def test_fast_path_header_stamps_stay_peekable():
+    buf = frame.encode(_FAST_ELIGIBLE[2])
+    info = frame.peek(buf)
+    assert info.seq == 7 and info.incarnation == 2
+    assert info.epoch == 5 and info.e2e_crc == 123456
+
+
+def test_fast_path_ineligible_payloads_fall_through():
+    """Anything outside the meta-stable shape returns None from the fast
+    encoder and rides the general path (which must still roundtrip)."""
+    cases = [
+        _ctl({"s": "text"}),  # non-int value
+        _ctl({"b": True}),  # bool is not int (type-exact check)
+        _ctl({"big": 1 << 70}),  # beyond the i64 slot
+        _ctl({resender_mod.SEQ_KEY: 1 << 70}),  # out-of-range stamp
+        _ctl({"nested": {"x": 1}}),
+    ]
+    for msg in cases:
+        assert frame._fast_encode(msg) is None
+        _assert_messages_equal(frame.decode(frame.encode(msg)), msg)
+
+
+def test_fast_path_never_mutates_payload():
+    payload = {resender_mod.SEQ_KEY: 3, "count": 9}
+    msg = _ctl(dict(payload))
+    frame.encode(msg)
+    assert msg.task.payload == payload
+
+
+def test_fast_cache_hit_reencodes_value_changes(monkeypatch):
+    """Same signature, different slot values: the cached template must be
+    re-patched per call, never replayed stale."""
+    monkeypatch.setattr(frame, "_FAST_ENC_CACHE", {})
+    a = _ctl({resender_mod.SEQ_KEY: 1, "n": 10}, time=5)
+    b = _ctl({resender_mod.SEQ_KEY: 2, "n": -20}, time=6)
+    ea, eb = frame.encode(a), frame.encode(b)
+    assert len(frame._FAST_ENC_CACHE) == 1  # one signature, one template
+    assert ea != eb
+    assert ea == _slow_encode(a) and eb == _slow_encode(b)
+
+
+def test_fast_cache_cap_bounds_memory_not_correctness(monkeypatch):
+    monkeypatch.setattr(frame, "_FAST_ENC_CACHE", {})
+    monkeypatch.setattr(frame, "_FAST_CACHE_CAP", 2)
+    msgs = [_ctl({f"k{i}": i}) for i in range(4)]
+    for m in msgs:
+        assert frame.encode(m) == _slow_encode(m)  # overflow still correct
+    assert len(frame._FAST_ENC_CACHE) == 2
+
+
 def test_frame_nbytes_is_exact():
     cases = [
         _msg(),

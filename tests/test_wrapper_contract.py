@@ -268,6 +268,96 @@ def test_verb_registry_load_fails_loudly(tmp_path):
         check_wrappers.load_verb_registry(unnamed)
 
 
+def test_push_ack_path_is_sync_free():
+    """The registered push-ack functions in kv/server.py contain no
+    blocking device syncs (ISSUE 11 satellite): acks return while the
+    donated device apply is still in flight."""
+    path = REPO / "parameter_server_tpu" / check_wrappers.SERVER_MODULE
+    assert path.is_file(), "server module moved: update SERVER_MODULE"
+    problems = check_wrappers.check_push_ack_sync_free(path)
+    assert problems == [], "\n".join(problems)
+
+
+def test_catches_sync_in_ack_path(tmp_path):
+    bad = tmp_path / "bad_server.py"
+    bad.write_text(
+        textwrap.dedent(
+            """
+            class KVServer:
+                def _ack_push(self, msg, tname, kn, segs):
+                    rows = np.asarray(self._last)      # D2H sync
+                    self._last.block_until_ready()     # explicit sync
+                    return msg.reply()
+
+                def _apply_push_group(self, group, replies):
+                    snap = jax.device_get(self._v)     # D2H sync
+                    return snap
+
+                def _push_group_rounds(self, *a):
+                    pass
+
+                def _push_group_combined(self, *a):
+                    pass
+            """
+        )
+    )
+    problems = check_wrappers.check_push_ack_sync_free(bad)
+    assert len(problems) == 3
+    assert "np.asarray" in problems[0]
+    assert "block_until_ready" in problems[1]
+    assert "jax.device_get" in problems[2]
+
+
+def test_sync_free_registry_fails_loudly_on_rename(tmp_path):
+    """A renamed registered function must FAIL the check — the contract
+    never passes vacuously against code it no longer reads."""
+    bad = tmp_path / "renamed_server.py"
+    bad.write_text(
+        textwrap.dedent(
+            """
+            class KVServer:
+                def _ack_push_v2(self, msg):
+                    return msg.reply()
+            """
+        )
+    )
+    problems = check_wrappers.check_push_ack_sync_free(bad)
+    assert len(problems) == 1
+    assert "missing" in problems[0]
+    assert "SYNC_FREE_FUNCS" in problems[0]
+
+
+def test_sync_free_allows_host_side_bookkeeping(tmp_path):
+    ok = tmp_path / "ok_server.py"
+    ok.write_text(
+        textwrap.dedent(
+            """
+            class KVServer:
+                def _ack_push(self, msg, tname, kn, segs):
+                    ver = self._seg_versions[tname]
+                    if segs.size:
+                        ver[segs] += 1
+                    hit = kn[(kn >= 0) & (kn < 10)]
+                    return msg.reply()
+
+                def _apply_push_group(self, group, replies):
+                    ids = np.concatenate([g[3] for g in group])
+                    stack = jnp.stack([g[1] for g in group])  # H2D is fine
+                    return ids, stack
+
+                def _push_group_rounds(self, *a):
+                    order = np.argsort(a[0], kind="stable")
+                    return order
+
+                def _push_group_combined(self, *a):
+                    u, inv = np.unique(a[0], return_inverse=True)
+                    return u, inv
+            """
+        )
+    )
+    assert check_wrappers.check_push_ack_sync_free(ok) == []
+
+
 def test_accepts_super_delegation(tmp_path):
     ok = tmp_path / "ok_van.py"
     ok.write_text(

@@ -46,6 +46,16 @@ PR 6, nothing enforced:
    registry parsed by AST via ``load_verb_registry``, same loud-failure
    stance as the event registry).
 
+6. **The PUSH-ack path never blocks on device work.**  The server's
+   bundle-batched apply engine (ISSUE 11) acks a push as soon as the
+   donated-buffer device apply is DISPATCHED; a ``np.asarray`` /
+   ``np.array`` / ``jax.device_get`` / ``.block_until_ready`` creeping
+   into the post-dispatch bookkeeping (:data:`SYNC_FREE_FUNCS` in
+   ``kv/server.py``) would silently put the whole device apply latency
+   back on every worker's ack round trip.  Enforced per registered
+   function (``check_push_ack_sync_free``); a registered function that
+   disappears (rename) is itself a loud failure, never a vacuous pass.
+
 Pure-AST check (no imports of the checked modules), so it runs in any
 environment and is wired as a tier-1 test (``tests/test_wrapper_contract.py``).
 Exit code 0 = clean; 1 = violations (one line each).
@@ -90,6 +100,28 @@ MANAGER_MODULE = "core/manager.py"
 #: bare-callable names treated as flight-recorder record aliases (the
 #: ``rec = recorder.record or flightrec.record`` pattern in utils/slo.py).
 _RECORD_ALIASES = frozenset({"record", "rec"})
+
+#: module holding the server's push-ack path, relative to the package root.
+SERVER_MODULE = "kv/server.py"
+
+#: ``kv/server.py`` functions on the PUSH-ack path — everything that runs
+#: AFTER the device apply is dispatched and BEFORE the ack returns.  These
+#: must never observe a device result: the ack's latency is host
+#: bookkeeping only.  (``_upload_values`` / ``_handle_push_single`` stay
+#: unregistered: their ``np.asarray`` touches the HOST wire plane before
+#: dispatch; ``_forward_push`` is wire I/O that deliberately blocks on the
+#: replica CHAIN ack in sync mode, not on device work.)
+SYNC_FREE_FUNCS = frozenset(
+    {
+        "_ack_push",
+        "_apply_push_group",
+        "_push_group_rounds",
+        "_push_group_combined",
+    }
+)
+
+#: ``np.<attr>`` calls that materialize a device array on the host.
+_SYNC_BANNED_NP = frozenset({"asarray", "array"})
 
 
 def _base_names(cls: ast.ClassDef) -> List[str]:
@@ -354,6 +386,54 @@ def check_flightrec_calls(path: pathlib.Path, events: frozenset) -> List[str]:
     return problems
 
 
+def check_push_ack_sync_free(path: pathlib.Path) -> List[str]:
+    """Ban blocking device syncs inside the registered push-ack functions.
+
+    Flags ``np.asarray`` / ``np.array`` / ``jax.device_get`` calls and any
+    ``.block_until_ready()`` inside a :data:`SYNC_FREE_FUNCS` function.  A
+    registry entry with no matching function definition is ITSELF a
+    violation — a rename must break this check loudly, never let the
+    contract pass vacuously against code it no longer reads.
+    """
+    tree = ast.parse(path.read_text(), filename=str(path))
+    problems: List[str] = []
+    funcs = {}
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and node.name in SYNC_FREE_FUNCS
+        ):
+            funcs[node.name] = node
+    missing = sorted(SYNC_FREE_FUNCS - set(funcs))
+    if missing:
+        problems.append(
+            f"{_rel(path)}: sync-free push-ack functions missing: "
+            f"{missing} — renamed?  Update SYNC_FREE_FUNCS in "
+            "tools/check_wrappers.py so the contract keeps checking the "
+            "real ack path"
+        )
+    for name, fn in sorted(funcs.items()):
+        for call in _calls(fn):
+            f = call.func
+            label = None
+            if isinstance(f, ast.Attribute):
+                if f.attr == "block_until_ready":
+                    label = ".block_until_ready()"
+                elif isinstance(f.value, ast.Name):
+                    if f.value.id == "np" and f.attr in _SYNC_BANNED_NP:
+                        label = f"np.{f.attr}()"
+                    elif f.value.id == "jax" and f.attr == "device_get":
+                        label = "jax.device_get()"
+            if label is not None:
+                problems.append(
+                    f"{_rel(path)}:{call.lineno}: {name} calls {label} — "
+                    "the push-ack path is sync-free by contract (the ack "
+                    "returns while the device apply is in flight); move "
+                    "the readback off this path"
+                )
+    return problems
+
+
 def check_control_verbs(
     path: pathlib.Path, verbs: frozenset, names: dict
 ) -> List[str]:
@@ -407,6 +487,7 @@ def main(argv: List[str]) -> int:
     problems: List[str] = []
     found_wrapper = False
     found_hot_path = 0
+    found_server = False
     try:
         events = load_event_registry(PKG / FLIGHTREC_MODULE)
     except (OSError, ValueError) as e:
@@ -427,6 +508,9 @@ def main(argv: List[str]) -> int:
             if rel in NO_PICKLE_MODULES:
                 found_hot_path += 1
                 problems.extend(check_no_pickle(f))
+            if rel == SERVER_MODULE:
+                found_server = True
+                problems.extend(check_push_ack_sync_free(f))
             problems.extend(check_flightrec_calls(f, events))
             problems.extend(check_control_verbs(f, verbs, verb_names))
             text = f.read_text()
@@ -437,6 +521,14 @@ def main(argv: List[str]) -> int:
     if not found_wrapper:
         print("check_wrappers: no VanWrapper subclasses found", file=sys.stderr)
         return 1  # a rename must fail loudly, not pass vacuously
+    if roots == [PKG] and not found_server:
+        # the sync-free push-ack contract must not pass vacuously if the
+        # server module moves
+        print(
+            "check_wrappers: kv/server.py not found — update SERVER_MODULE",
+            file=sys.stderr,
+        )
+        return 1
     if roots == [PKG] and found_hot_path != len(NO_PICKLE_MODULES):
         # same loud-failure stance: a moved/renamed hot-path module must not
         # let the pickle ban pass vacuously
