@@ -118,6 +118,42 @@ class ApplyEngineConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class LedgerConfig:
+    """Device-plane observability knobs (the server's ApplyLedger).
+
+    PR 11 made PUSH acks sync-free, so the ack no longer observes the
+    device apply at all — true apply latency, device queue depth, and the
+    host-assembly/H2D/compute split became invisible.  The ledger
+    (``kv/ledger.py``) registers every in-flight apply at dispatch and
+    retires it from a background reaper thread once ``is_ready()`` — never
+    from the ack path, so the sync-free contract holds.  Between
+    completions the reaper blocks inside the runtime on the oldest
+    in-flight result; ``reap_interval_s`` is only the degraded-mode poll
+    cadence (donated-buffer races, ``drain``).
+
+    Backlog bounds drive the soft-backpressure hint: when any configured
+    bound is exceeded, the server stamps ``__busy__`` into push acks (the
+    admission-control signal the serving plane consumes) and the
+    ``apply.backlog`` flight-recorder event fires edge-triggered.  A bound
+    of 0 disables that bound; all bounds 0 (the default) means the ledger
+    observes but never hints.
+    """
+
+    enabled: bool = True
+    #: reaper poll period; also bounds device-latency measurement error.
+    reap_interval_s: float = 0.001
+    #: reaper self-stops after this long with nothing in flight (restarted
+    #: lazily on the next submit) — idle servers pay zero poll cost.
+    idle_stop_s: float = 2.0
+    #: backpressure bounds (0 = unbounded): in-flight device applies ...
+    backlog_bundles: int = 0
+    #: ... in-flight rows across those applies ...
+    backlog_rows: int = 0
+    #: ... and age of the oldest un-retired apply, in seconds.
+    backlog_age_s: float = 0.0
+
+
+@dataclasses.dataclass(frozen=True)
 class TableConfig:
     """A KV table: the unit the reference range-partitions across servers.
 

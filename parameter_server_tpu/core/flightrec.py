@@ -86,6 +86,13 @@ EVENTS = frozenset({
     # duplicate-seq frame dropped by the scheduler's aggregator
     "telemetry.publish",
     "telemetry.drop",
+    # device-plane apply ledger (kv/ledger.py): an in-flight device apply
+    # registered at dispatch / retired by the reaper once the donated
+    # buffers are ready / backlog bound crossed (edge-triggered both ways,
+    # state field says which)
+    "apply.submit",
+    "apply.done",
+    "apply.backlog",
 })
 
 #: env var: when set, recv-thread exceptions auto-dump a bundle here.
@@ -376,4 +383,5 @@ def anomaly_kinds() -> frozenset:
         "migrate.abort",
         "recv.exception",
         "slo.breach",
+        "apply.backlog",
     })

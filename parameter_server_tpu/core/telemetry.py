@@ -126,6 +126,7 @@ class TelemetryPublisher:
         self._prev_counters: Dict[str, float] = {}
         self._prev_links: Dict[str, dict] = {}
         self._prev_series: Dict[str, dict] = {}
+        self._prev_latency: Dict[str, dict] = {}
         #: flight-recorder seq watermark: events <= this are already reported.
         self._ev_seq = -1
 
@@ -224,6 +225,27 @@ class TelemetryPublisher:
                         series[name] = dd
             if series:
                 out["staleness"] = series
+            # -- device-plane latency digest series (ISSUE 12) ---------------
+            # Same delta framing, separate frame field: staleness rides a
+            # unitless axis (pstop's STALE column takes the max-p99 across
+            # the field), while these are seconds-axis apply attributions
+            # (apply.<t> + host/h2d/dev splits from the ApplyLedger).
+            lat: Dict[str, dict] = {}
+            for src in self.sources:
+                get = getattr(src, "latency_digests", None)
+                if not callable(get):
+                    continue
+                try:
+                    digests = get()
+                except Exception:  # pragma: no cover — telemetry never crashes
+                    continue
+                for name, dig in digests.items():
+                    dd = delta_digest(self._prev_latency.get(name), dig)
+                    self._prev_latency[name] = dig
+                    if dd:
+                        lat[name] = dd
+            if lat:
+                out["digests"] = lat
             # -- local SLO verdicts ------------------------------------------
             if self.verdicts_fn is not None:
                 try:
@@ -285,6 +307,10 @@ class TelemetryAggregator:
         self.frames = 0
         self.duplicates = 0
         self.late = 0
+        #: per-node duplicate/stale-frame drops (control-plane self-metric:
+        #: ROADMAP names ring sizing a scaling risk — drops were journaled
+        #: but never surfaced per node until ISSUE 12).
+        self._drops: Dict[str, int] = {}
         self.writer: Optional[RotatingJsonlWriter] = (
             RotatingJsonlWriter(jsonl_path, rotate_bytes=rotate_bytes)
             if jsonl_path is not None
@@ -300,6 +326,7 @@ class TelemetryAggregator:
             have = self._max_seq.get(node, 0)
             if seq <= have:
                 self.duplicates += 1
+                self._drops[node] = self._drops.get(node, 0) + 1
                 flightrec.record(
                     "telemetry.drop", node=node, seq=seq, have=have
                 )
@@ -344,10 +371,28 @@ class TelemetryAggregator:
                 if h is None:
                     h = self._cum_series[(node, name)] = LatencyHistogram()
                 try:
-                    h.merge(LatencyHistogram.from_dict(dd))
+                    h.merge_dict(dd)
                 except Exception:
                     continue  # a malformed series must not drop the frame
                 stale_stats[name] = {
+                    "count": h.count,
+                    "p50": round(h.percentile(0.50), 6),
+                    "p99": round(h.percentile(0.99), 6),
+                }
+                if name in want_digest:
+                    slo_digests[name] = h.to_dict()
+            # device-plane latency series: same cumulative fold, own frame
+            # field + row field (seconds axis — consumers scale to ms)
+            lat_stats: Dict[str, dict] = {}
+            for name, dd in (frame.get("digests") or {}).items():
+                h = self._cum_series.get((node, name))
+                if h is None:
+                    h = self._cum_series[(node, name)] = LatencyHistogram()
+                try:
+                    h.merge_dict(dd)
+                except Exception:
+                    continue  # a malformed series must not drop the frame
+                lat_stats[name] = {
                     "count": h.count,
                     "p50": round(h.percentile(0.50), 6),
                     "p99": round(h.percentile(0.99), 6),
@@ -412,6 +457,8 @@ class TelemetryAggregator:
             row["deliver_p50_ms"] = round(1e3 * deliver.percentile(0.50), 3)
         if stale_stats:
             row["staleness"] = stale_stats
+        if lat_stats:
+            row["digests"] = lat_stats
         if frame.get("events"):
             row["events"] = dict(frame["events"])
         if mig > 0:
@@ -428,6 +475,15 @@ class TelemetryAggregator:
                 node, collections.deque(maxlen=self.window)
             )
             ring.append(row)
+            # control-plane self-metrics (ISSUE 12): the aggregator's own
+            # state rides every derived row, so ring pressure and dedup
+            # drops are visible downstream (pstop DRP column) without a
+            # side channel.  Occupancy is post-append: cap hit => eviction.
+            row["ctl"] = {
+                "ring": len(ring),
+                "ring_cap": self.window,
+                "drops": self._drops.get(node, 0),
+            }
         if self.writer is not None:
             self.writer.write_line(json.dumps(row))
         return True
@@ -465,6 +521,11 @@ class TelemetryAggregator:
                 "telemetry_dup_frames": self.duplicates,
                 "telemetry_late_frames": self.late,
             }
+
+    def drops(self, node: str) -> int:
+        """Cumulative duplicate/stale-frame drops for ``node``."""
+        with self._lock:
+            return self._drops.get(node, 0)
 
     def flush_jsonl(self) -> None:
         if self.writer is not None:

@@ -45,6 +45,12 @@ FENCED_KEY = "__fenced__"
 #: staleness plane's wire carrier (ISSUE 10).  Lives here with the other
 #: wire keys because it is part of the same request/reply payload contract.
 VERSION_KEY = "__sver__"
+#: reply payload key: soft-backpressure hint stamped onto PUSH acks when
+#: the server's ApplyLedger backlog exceeds its configured bound (ISSUE
+#: 12).  Advisory, not a reject: the update WAS accepted; the worker's
+#: admission control should slow down or shed load.  Same wire-contract
+#: home as the other reply keys.
+BUSY_KEY = "__busy__"
 
 
 @dataclasses.dataclass(frozen=True)

@@ -37,12 +37,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from parameter_server_tpu.config import ApplyEngineConfig, TableConfig
+from parameter_server_tpu.config import ApplyEngineConfig, LedgerConfig, TableConfig
 from parameter_server_tpu.core import flightrec
 from parameter_server_tpu.core.messages import Message, Task, TaskKind
 from parameter_server_tpu.core.postoffice import Customer, Postoffice
+from parameter_server_tpu.kv.ledger import ApplyLedger
 from parameter_server_tpu.kv.partition import RangePartition
 from parameter_server_tpu.kv.routing import (
+    BUSY_KEY,
     FENCED_KEY,
     ROUTING_EPOCH_KEY,
     ROUTING_KEY,
@@ -79,6 +81,7 @@ class KVServer(Customer):
         routing: Optional[RoutingTable] = None,
         migrate_timeout: float = 30.0,
         apply: Optional[ApplyEngineConfig] = None,
+        devobs: Optional[LedgerConfig] = None,
     ) -> None:
         """``replica``: node id of a hot-standby KVServer holding the same
         shard (chain replication of key ranges, the reference paper's §4.3
@@ -106,6 +109,14 @@ class KVServer(Customer):
                 f"dup_policy must be rounds|combine, "
                 f"got {self.apply_cfg.dup_policy!r}"
             )
+        #: device-plane observability (ISSUE 12): the ApplyLedger registers
+        #: every dispatched device apply and retires it from its own reaper
+        #: thread — the ack path only READS the level-triggered
+        #: ``overloaded()`` flag (the ``__busy__`` hint), never device state.
+        devobs = devobs or LedgerConfig()
+        self.ledger: Optional[ApplyLedger] = (
+            ApplyLedger(post.node_id, devobs) if devobs.enabled else None
+        )
         #: reply to pulls with device arrays instead of host numpy — the
         #: zero-copy mode for in-process (Loopback) planes where worker and
         #: server share the device; cross-host Vans keep numpy replies.
@@ -345,7 +356,7 @@ class KVServer(Customer):
 
     def counters(self) -> dict:
         """Migration/fence counters, Dashboard-mergeable (utils.metrics)."""
-        return {
+        out = {
             "fenced_rejects": self.fenced_rejects,
             "rows_migrated_in": self.rows_migrated_in,
             "rows_migrated_out": self.rows_migrated_out,
@@ -356,6 +367,19 @@ class KVServer(Customer):
                 self.version_max(t) for t in self.tables
             ),
         }
+        if self.ledger is not None:
+            # device-plane gauges + totals (inflight_bundles/rows,
+            # backlog_age_s, applies_*): ride the same counter channel —
+            # telemetry's delta framing reconstructs gauges exactly
+            out.update(self.ledger.counters())
+        return out
+
+    def latency_digests(self) -> Dict[str, dict]:
+        """Device-plane apply attribution digests for the telemetry
+        publisher (``apply.<t>`` total + host/h2d/dev splits, cumulative)."""
+        return (
+            self.ledger.latency_digests() if self.ledger is not None else {}
+        )
 
     # -- request handling -----------------------------------------------------
     def _span_attrs(self, msg: Message, tname: str) -> dict:
@@ -427,7 +451,7 @@ class KVServer(Customer):
         return vals
 
     def _stack_planes(
-        self, table: KVTable, group: List[tuple], k: int, bm: int
+        self, table: KVTable, group: List[tuple], k: int, bm: int, tok=None
     ) -> jax.Array:
         """Assemble the bundle's ``(k, bm, dim)`` value stack.
 
@@ -447,12 +471,22 @@ class KVServer(Customer):
                 buf[i, :n] = np.asarray(m.values[0]).reshape(n, dim)
                 if n < bm:  # pads must stay exact zeros (bitwise-neutral)
                     buf[i, n:] = 0.0
-            return jnp.asarray(buf)
+            if tok is not None:
+                tok.mark_host()  # pinned-buffer pack done; H2D is next
+            stack = jnp.asarray(buf)
+            if tok is not None:
+                tok.mark_h2d()
+            return stack
         planes = []
         for _, m, _, ids_np, _, _ in group:
             n = int(ids_np.shape[0])
             planes.append(self._upload_values(m.values[0], bm, n))
-        return jnp.stack(planes)
+        if tok is not None:
+            tok.mark_host()  # device-resident planes: no host pack phase
+        stack = jnp.stack(planes)
+        if tok is not None:
+            tok.mark_h2d()
+        return stack
 
     def _handle_push_single(
         self,
@@ -465,10 +499,20 @@ class KVServer(Customer):
         table = self.tables[tname]
         n = int(ids_np.shape[0])
         b = _bucket(n)
-        ids = jnp.asarray(self._pad_ids(table, ids_np, b))
+        tok = (
+            self.ledger.begin(tname, 1, n) if self.ledger is not None else None
+        )
+        ids_host = self._pad_ids(table, ids_np, b)
+        if tok is not None:
+            tok.mark_host()
+        ids = jnp.asarray(ids_host)
         vals = self._upload_values(msg.values[0], b, n)
+        if tok is not None:
+            tok.mark_h2d()
         with self.tracer.span("kv.server.push", **self._span_attrs(msg, tname)):
-            table.push(ids, vals)
+            ref = table.push(ids, vals)
+        if tok is not None:
+            self.ledger.submit(tok, ref, lambda t=table: t.value)
         return self._ack_push(msg, tname, kn, segs)
 
     def _ack_push(
@@ -507,7 +551,16 @@ class KVServer(Customer):
             # thread is the only writer), so the standby replays the
             # identical update sequence
             self._forward_push(tname, msg)
-        return self._stamp_version(msg, msg.reply(), sver)
+        reply = self._stamp_version(msg, msg.reply(), sver)
+        if self.ledger is not None and self.ledger.overloaded():
+            # soft backpressure: the update WAS applied; the hint tells the
+            # worker's admission control to slow down.  overloaded() is a
+            # host-side flag maintained by the reaper — reading it here
+            # keeps the ack sync-free.  _stamp_version already replaced the
+            # Task payload with a fresh dict, so this cannot leak into the
+            # sender's payload object on a Loopback plane.
+            reply.task.payload[BUSY_KEY] = True
+        return reply
 
     def _pull_device(
         self, msg: Message, tname: str, ids_np: np.ndarray, segs: np.ndarray
@@ -671,10 +724,15 @@ class KVServer(Customer):
         table = self.tables[tname]
         k = len(group)
         bm = _bucket(max(int(g[3].shape[0]) for g in group))
+        tok = (
+            self.ledger.begin(tname, k, sum(int(g[3].shape[0]) for g in group))
+            if self.ledger is not None
+            else None
+        )
         with self.tracer.span(
             "kv.server.push_batch", table=tname, members=k
         ):
-            stack = self._stack_planes(table, group, k, bm)
+            stack = self._stack_planes(table, group, k, bm, tok)
             # flat positions of every REAL id occurrence, in member order
             ids_list = [g[3] for g in group]
             all_ids = np.concatenate(ids_list).astype(np.int64)
@@ -688,9 +746,11 @@ class KVServer(Customer):
             rid = all_ids[real]
             rpos = flat_pos[real]
             if self.apply_cfg.dup_policy == "combine":
-                self._push_group_combined(table, k, bm, rid, rpos, stack)
+                ref = self._push_group_combined(table, k, bm, rid, rpos, stack)
             else:
-                self._push_group_rounds(table, k, bm, rid, rpos, stack)
+                ref = self._push_group_rounds(table, k, bm, rid, rpos, stack)
+        if tok is not None:
+            self.ledger.submit(tok, ref, lambda t=table: t.value)
         for i, m, tname_, _, kn, segs in group:
             replies[i] = self._ack_push(m, tname_, kn, segs)
 
@@ -702,7 +762,7 @@ class KVServer(Customer):
         rid: np.ndarray,
         rpos: np.ndarray,
         stack: jax.Array,
-    ) -> None:
+    ) -> jax.Array:
         """Occurrence-round partitioning: round ``t`` applies each row's
         ``t``-th contribution in member order.  Row updates are independent
         and the optimizer is row-wise, so the per-row grad sequence — and
@@ -726,6 +786,7 @@ class KVServer(Customer):
                 (sid[occ == t], spos[occ == t])
                 for t in range(int(occ.max()) + 1)
             ]
+        ref = None
         for uids_t, pos_t in rounds:
             nt = int(uids_t.size)
             bu = _bucket(nt)
@@ -733,7 +794,10 @@ class KVServer(Customer):
             ids_np[:nt] = uids_t.astype(np.int32)
             pos_np = np.full(bu, pad_pos, dtype=np.int32)
             pos_np[:nt] = pos_t
-            table.push_batch(jnp.asarray(ids_np), jnp.asarray(pos_np), stack)
+            ref = table.push_batch(
+                jnp.asarray(ids_np), jnp.asarray(pos_np), stack
+            )
+        return ref  # last round's value: its readiness bounds every round
 
     def _push_group_combined(
         self,
@@ -743,7 +807,7 @@ class KVServer(Customer):
         rid: np.ndarray,
         rpos: np.ndarray,
         stack: jax.Array,
-    ) -> None:
+    ) -> jax.Array:
         """Device pre-merge: duplicate rows across members segment-sum into
         one gradient row (the reference's ParallelOrderedMatch merge), then
         ONE apply — classic PS sum semantics (sequential-identical only for
@@ -759,7 +823,9 @@ class KVServer(Customer):
         ids_np[:nu] = uids.astype(np.int32)
         inverse = np.full(k * bm, min(nu, bu - 1), dtype=np.int32)
         inverse[rpos] = inv_real.astype(np.int32)
-        table.push_combined(jnp.asarray(ids_np), jnp.asarray(inverse), stack)
+        return table.push_combined(
+            jnp.asarray(ids_np), jnp.asarray(inverse), stack
+        )
 
     # -- shard transfer (same-id restart: kv/replica.restart_same_id) --------
     def export_shard(self) -> Dict[str, dict]:

@@ -136,35 +136,46 @@ class KVTable:
         return self.optimizer.pull_weights(v_rows, s_rows)
 
     # -- public ops ---------------------------------------------------------
-    def push(self, ids: jax.Array, combined_grads: jax.Array) -> None:
+    def push(self, ids: jax.Array, combined_grads: jax.Array) -> jax.Array:
         """Apply pre-combined gradient rows at unique ``ids`` (in place).
 
         ``ids`` must be unique (host guarantees via ``localize_to_slots``);
         padded ids point at the trash row and must carry zero gradients.
+        Returns the new ``value`` array so the caller can hand it to the
+        ApplyLedger as the readiness ref for this dispatch (the NEXT push
+        donates it away, so polling through ``self.value`` would observe a
+        later apply, not this one).
         """
         self.value, self.state = self._push_fn(
             self.value, self.state, ids, combined_grads
         )
+        return self.value
 
     def push_batch(
         self, ids: jax.Array, positions: jax.Array, vals: jax.Array
-    ) -> None:
+    ) -> jax.Array:
         """One bundled apply round: unique ``ids`` gather their gradient rows
         out of the stacked member values by ``positions`` (pad positions index
-        the appended zero row).  Donated in-place update, one jit call."""
+        the appended zero row).  Donated in-place update, one jit call.
+        Returns the new ``value`` (ledger readiness ref, as in :meth:`push`).
+        """
         self.value, self.state = self._push_batch_fn(
             self.value, self.state, ids, positions, vals
         )
+        return self.value
 
     def push_combined(
         self, ids: jax.Array, inverse: jax.Array, vals: jax.Array
-    ) -> None:
+    ) -> jax.Array:
         """Bundled apply with device pre-combine: every stacked value row is
         segment-summed into its unique-id slot (``inverse``), then applied in
-        one donated jit call — the ``dup_policy="combine"`` engine mode."""
+        one donated jit call — the ``dup_policy="combine"`` engine mode.
+        Returns the new ``value`` (ledger readiness ref, as in :meth:`push`).
+        """
         self.value, self.state = self._push_combined_fn(
             self.value, self.state, ids, inverse, vals
         )
+        return self.value
 
     def combine(self, inverse: jax.Array, values: jax.Array, num_rows: int) -> jax.Array:
         """Worker-side duplicate pre-combine (device segment_sum)."""

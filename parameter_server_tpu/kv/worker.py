@@ -44,6 +44,7 @@ from parameter_server_tpu.core.messages import Message, Task, TaskKind, server_i
 from parameter_server_tpu.core.postoffice import Customer, Postoffice
 from parameter_server_tpu.kv.partition import RangePartition
 from parameter_server_tpu.kv.routing import (
+    BUSY_KEY,
     FENCED_KEY,
     ROUTING_EPOCH_KEY,
     ROUTING_KEY,
@@ -126,6 +127,12 @@ class KVWorker(Customer):
         self._staleness_lock = threading.Lock()
         #: total lag samples recorded (Dashboard-mergeable gauge)
         self.staleness_samples = 0
+        # -- device-plane backpressure (ISSUE 12) ----------------------------
+        #: total ``__busy__``-hinted acks seen (Dashboard-mergeable)
+        self.busy_hints = 0
+        #: monotonic stamp of the last busy hint per server — the admission
+        #: signal a throttling training loop polls via :meth:`server_busy`
+        self._busy_last: Dict[str, float] = {}
 
     # -- routing --------------------------------------------------------------
     def adopt_routing(self, routing) -> bool:
@@ -154,7 +161,16 @@ class KVWorker(Customer):
             "push_retries": self.push_retries,
             "refresh_retries": self.refresh_retries,
             "staleness_samples": self.staleness_samples,
+            "busy_hints": self.busy_hints,
         }
+
+    def server_busy(self, server: str, within_s: float = 1.0) -> bool:
+        """True if ``server`` stamped ``__busy__`` onto an ack within the
+        last ``within_s`` seconds — the soft-backpressure poll a throttling
+        training loop consumes (the hint is advisory: pushes were applied)."""
+        with self._staleness_lock:
+            t = self._busy_last.get(server)
+        return t is not None and (time.monotonic() - t) <= within_s
 
     # -- staleness observability (ISSUE 10) -----------------------------------
     def _on_response(self, msg) -> None:
@@ -172,6 +188,13 @@ class KVWorker(Customer):
         """
         try:
             payload = msg.task.payload
+            if payload.get(BUSY_KEY):
+                # device-plane soft backpressure (ISSUE 12): the server's
+                # ApplyLedger backlog exceeded its bound when this ack was
+                # stamped.  Count + timestamp; :meth:`server_busy` reads it.
+                with self._staleness_lock:
+                    self.busy_hints += 1
+                    self._busy_last[msg.sender] = time.monotonic()
             sver = payload.get(VERSION_KEY)
             table = payload.get("table")
             if sver is not None and table is not None:

@@ -122,6 +122,15 @@ class SloEngine:
         self._recorder = recorder
         #: metrics any spec reads — bulk ingest skips everything else.
         self._spec_metrics = frozenset(s.metric for s in self.specs)
+        #: widest window any spec holds over a metric: samples older than
+        #: this are dead to EVERY spec, so ``_windowed`` may expire them
+        #: from the series for good (amortized O(1) per sample) instead of
+        #: re-scanning past them on every evaluation.
+        self._max_window: Dict[str, float] = {}
+        for s in self.specs:
+            self._max_window[s.metric] = max(
+                self._max_window.get(s.metric, 0.0), s.window_s
+            )
         #: (node, metric) -> deque of (t, value-or-digest-dict) samples.
         self._series: Dict[Tuple[str, str], Deque[Tuple[float, object]]] = {}
         #: series keys that ever saw an out-of-order sample; only these pay
@@ -205,10 +214,25 @@ class SloEngine:
         # delivers frames out of order (ISSUE 10), and a LATE old sample
         # must not masquerade as the window's latest gauge / rate endpoint.
         # A series that only ever appended in order is already time-sorted;
-        # only series flagged by ``observe`` pay the sort.
-        window = [s for s in dq if s[0] >= cutoff]
+        # only series flagged by ``observe`` pay a filter + sort.
         if (node, spec.metric) in self._unsorted:
-            window.sort(key=lambda s: s[0])
+            window: object = sorted(
+                (s for s in dq if s[0] >= cutoff), key=lambda s: s[0]
+            )
+        else:
+            # time-sorted series: expire samples no spec can ever read
+            # again (evaluate's ``now`` only moves forward, so neither can
+            # any cutoff) — each sample is popped at most once across the
+            # engine's whole lifetime instead of re-scanned every sweep
+            expire = now - self._max_window[spec.metric]
+            while dq and dq[0][0] < expire:
+                dq.popleft()
+            if not dq:
+                return None
+            if dq[0][0] >= cutoff:
+                window = dq  # everything in window — evaluate in place
+            else:
+                window = [s for s in dq if s[0] >= cutoff]
         if len(window) < spec.min_samples:
             return None
         if spec.source == "gauge":
@@ -295,6 +319,41 @@ class SloEngine:
             breached and name_node[1] == node
             for name_node, breached in self._breached.items()
         )
+
+
+def device_plane_specs(
+    table: str = "w",
+    *,
+    apply_p99_ms: float = 250.0,
+    backlog_bundles: int = 8,
+    window_s: float = 10.0,
+) -> List[SloSpec]:
+    """The ISSUE-12 device-plane SLO pair, wired to the ApplyLedger series.
+
+    - ``apply-p99``: windowed p99 of the ``apply.<table>`` total-latency
+      digest (submit -> retire, milliseconds) the ledger publishes through
+      the telemetry ``digests`` channel;
+    - ``apply-backlog``: the ``inflight_bundles`` gauge riding the server's
+      ``counters()`` — the canonical async-PS overload signal.  Breaching
+      it flips ``SloEngine.healthy(node)``, the same signal the server's
+      soft-backpressure ``__busy__`` hint mirrors locally.
+    """
+    return [
+        SloSpec(
+            "apply-p99",
+            f"apply.{table}",
+            apply_p99_ms,
+            source="p99",
+            window_s=window_s,
+        ),
+        SloSpec(
+            "apply-backlog",
+            "inflight_bundles",
+            float(backlog_bundles),
+            source="gauge",
+            window_s=window_s,
+        ),
+    ]
 
 
 def _delta_hist(first: dict, last: dict) -> LatencyHistogram:

@@ -50,6 +50,9 @@ class LatencyHistogram:
     GROWTH = 1.25
     NBUCKETS = 96
     _LOG_G = math.log(GROWTH)
+    #: interned bucket-key strings — ``to_dict`` runs per telemetry frame
+    #: on hot paths; 96 ``str(i)`` calls per digest add up.
+    _BKEYS = tuple(str(i) for i in range(NBUCKETS))
 
     __slots__ = ("counts", "count", "sum_s", "max_s")
 
@@ -82,6 +85,19 @@ class LatencyHistogram:
         self.count += other.count
         self.sum_s += other.sum_s
         self.max_s = max(self.max_s, other.max_s)
+        return self
+
+    def merge_dict(self, d: dict) -> "LatencyHistogram":
+        """Fold a ``to_dict`` digest in without materializing it — touches
+        only the sparse occupied buckets, so merging a per-frame DELTA
+        digest (usually one or two buckets) costs O(buckets present), not
+        O(NBUCKETS).  The telemetry aggregator's per-frame cumulative fold
+        is exactly that shape."""
+        for i, c in (d.get("b") or {}).items():
+            self.counts[int(i)] += int(c)
+        self.count += int(d.get("count", 0))
+        self.sum_s += float(d.get("sum_s", 0.0))
+        self.max_s = max(self.max_s, float(d.get("max_s", 0.0)))
         return self
 
     def percentile(self, p: float) -> float:
@@ -117,7 +133,7 @@ class LatencyHistogram:
             "count": self.count,
             "sum_s": self.sum_s,
             "max_s": self.max_s,
-            "b": {str(i): c for i, c in enumerate(self.counts) if c},
+            "b": {self._BKEYS[i]: c for i, c in enumerate(self.counts) if c},
         }
 
     @classmethod

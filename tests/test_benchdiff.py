@@ -1,0 +1,101 @@
+"""tools/benchdiff (ISSUE 12 satellite): bench-arm diffing + the
+``--fail-over`` regression gate over driver wrappers and BASELINE.md."""
+
+import json
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1] / "tools"))
+import benchdiff  # noqa: E402
+
+
+def _wrapper(tmp_path, name, throughput, lat_ms):
+    """One driver-wrapper BENCH_*.json: ``parsed`` plus a metric tail line
+    (benchdiff samples both; last tail line wins on duplicates)."""
+    tail = json.dumps(
+        {"metric": "step_latency", "value": lat_ms, "unit": "ms"}
+    )
+    blob = {
+        "n": 1,
+        "cmd": "python bench.py --x",
+        "rc": 0,
+        "tail": f"noise\n{tail}\n",
+        "parsed": {
+            "metric": "sparse_lr_throughput",
+            "value": throughput,
+            "unit": "examples/s",
+        },
+    }
+    p = tmp_path / name
+    p.write_text(json.dumps(blob))
+    return str(p)
+
+
+def test_direction_inference():
+    assert benchdiff.direction("sparse_lr_throughput", "examples/s") == 1
+    assert benchdiff.direction("step_latency", "ms") == -1
+    assert benchdiff.direction("mystery_metric", "") == 0
+
+
+def test_diff_values_and_directions(tmp_path):
+    old = _wrapper(tmp_path, "a.json", throughput=100.0, lat_ms=10.0)
+    new = _wrapper(tmp_path, "b.json", throughput=80.0, lat_ms=12.0)
+    rows = benchdiff.diff(benchdiff.load(old), benchdiff.load(new))
+    by_name = {r[0]: r for r in rows}
+    assert set(by_name) == {"sparse_lr_throughput", "step_latency"}
+    name, a, b, pct, sign = by_name["sparse_lr_throughput"]
+    assert (a, b, sign) == (100.0, 80.0, 1) and round(pct) == -20
+    name, a, b, pct, sign = by_name["step_latency"]
+    assert (a, b, sign) == (10.0, 12.0, -1) and round(pct) == 20
+
+
+def test_fail_over_gates_regressions_both_directions(tmp_path):
+    good = _wrapper(tmp_path, "good.json", throughput=100.0, lat_ms=10.0)
+    bad = _wrapper(tmp_path, "bad.json", throughput=80.0, lat_ms=12.0)
+    # regression beyond the gate in BOTH directional senses -> rc 1
+    assert benchdiff.main([good, bad, "--fail-over", "10"]) == 1
+    # the same move read as an improvement (baseline/candidate swapped)
+    assert benchdiff.main([bad, good, "--fail-over", "10"]) == 0
+    # gate wide enough to tolerate the move -> rc 0
+    assert benchdiff.main([good, bad, "--fail-over", "25"]) == 0
+    # no gate: informational diff only
+    assert benchdiff.main([good, bad]) == 0
+
+
+def test_usage_and_load_errors_are_rc2(tmp_path):
+    one = _wrapper(tmp_path, "one.json", 1.0, 1.0)
+    assert benchdiff.main([one]) == 2  # needs baseline + candidate
+    missing = str(tmp_path / "nope.json")
+    assert benchdiff.main([one, missing]) == 2
+    empty = tmp_path / "empty.json"
+    empty.write_text("{}")
+    assert benchdiff.main([str(empty), one]) == 2  # no metrics in baseline
+
+
+def test_baseline_md_blocks_parse_tables_and_headlines(tmp_path):
+    md = tmp_path / "BASELINE.md"
+    md.write_text(
+        "# baseline\n\n"
+        "<!-- BENCH-OBS:BEGIN -->\n"
+        "| arm | ms/step |\n|---|---|\n"
+        "| plane on | 20.61 |\n"
+        "| plane off | 20.79 |\n\n"
+        "Overhead: **-0.86%** against a 3.0% budget — PASS.\n"
+        "<!-- BENCH-OBS:END -->\n"
+    )
+    samples = benchdiff.load(str(md))
+    assert samples["obs/plane on/ms/step"]["value"] == 20.61
+    assert samples["obs/overhead"]["value"] == -0.86
+    # self-diff: every metric shared, zero delta, no regressions
+    rows = benchdiff.diff(samples, samples)
+    assert rows and all(r[3] == 0.0 for r in rows)
+    assert benchdiff.regressions(rows, 0.1) == []
+
+
+def test_repo_baseline_md_self_diffs_clean():
+    """The real BASELINE.md stays parseable: the gate can run in CI
+    against ``git show HEAD~1:BASELINE.md`` without a per-metric config."""
+    repo = pathlib.Path(__file__).resolve().parents[1]
+    samples = benchdiff.load(str(repo / "BASELINE.md"))
+    assert len(samples) > 20  # arms spliced by bench.py are all visible
+    assert benchdiff.regressions(benchdiff.diff(samples, samples), 1.0) == []

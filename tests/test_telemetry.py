@@ -453,3 +453,69 @@ def test_telemetry_rides_heartbeat_and_dedups_on_wire():
     finally:
         van.close()
         flightrec.configure(clear=True)
+
+
+# ----------------------------------------------- device-plane channel (ISSUE 12)
+
+
+class _LedgerSrc:
+    """Minimal device-plane source: apply-latency digests like ApplyLedger."""
+
+    def __init__(self):
+        self.hist = LatencyHistogram()
+
+    def counters(self):
+        return {"applies_submitted": self.hist.count}
+
+    def latency_digests(self):
+        return {"apply.w": self.hist.to_dict()}
+
+
+def test_latency_digest_channel_deltas_then_cumulative_fold():
+    """Publisher delta-encodes ``latency_digests()`` into ``frame["digests"]``;
+    the aggregator folds each delta into a cumulative per-(node, series)
+    histogram and re-derives count/p50/p99 on every row."""
+    src = _LedgerSrc()
+    agg = TelemetryAggregator()
+    pub = TelemetryPublisher("S0", None,
+                             recorder=flightrec.FlightRecorder(capacity=8),
+                             sources=[src])
+    src.hist.record(0.010)
+    f1 = pub.frame(now=1.0)
+    assert f1["digests"]["apply.w"]["count"] == 1
+    agg.ingest("S0", f1, now=1.0)
+    # quiet frame: the series is unchanged, so no digests section at all
+    f2 = pub.frame(now=2.0)
+    assert "digests" not in f2
+    agg.ingest("S0", f2, now=2.0)
+    src.hist.record(0.030)
+    f3 = pub.frame(now=3.0)
+    assert f3["digests"]["apply.w"]["count"] == 1  # the DELTA, not cum=2
+    agg.ingest("S0", f3, now=3.0)
+    row = agg.rows("S0")[-1]
+    stats = row["digests"]["apply.w"]
+    assert stats["count"] == 2  # cumulative across delta frames
+    assert 0.010 <= stats["p50"] <= stats["p99"]
+    assert stats["p99"] >= 0.030 * 0.8  # bucket-resolution upper bound
+
+
+def test_aggregator_ctl_self_metrics_ride_every_row():
+    """Control-plane self-observability (ISSUE 12 satellite): ring occupancy
+    against capacity and per-node dedup drops ride each derived row."""
+    agg = TelemetryAggregator(window=4)
+    pub = TelemetryPublisher("S0", None,
+                             recorder=flightrec.FlightRecorder(capacity=8))
+    f1 = pub.frame(now=1.0)
+    agg.ingest("S0", f1, now=1.0)
+    row = agg.rows("S0")[-1]
+    assert row["ctl"] == {"ring": 1, "ring_cap": 4, "drops": 0}
+    # replay the same frame: dropped as a duplicate, counted per node
+    assert agg.ingest("S0", f1, now=1.5) is False
+    agg.ingest("S0", pub.frame(now=2.0), now=2.0)
+    row = agg.rows("S0")[-1]
+    assert row["ctl"] == {"ring": 2, "ring_cap": 4, "drops": 1}
+    # another node's drops are accounted separately
+    pub_b = TelemetryPublisher("S1", None,
+                               recorder=flightrec.FlightRecorder(capacity=8))
+    agg.ingest("S1", pub_b.frame(now=2.5), now=2.5)
+    assert agg.rows("S1")[-1]["ctl"]["drops"] == 0

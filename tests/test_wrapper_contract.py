@@ -378,3 +378,84 @@ def test_accepts_super_delegation(tmp_path):
         )
     )
     assert check_wrappers.check_file(ok) == []
+
+
+def test_ledger_submit_path_is_sync_free():
+    """The ApplyLedger's ack-path methods (ISSUE 12) obey the same AST
+    ban as the push-ack functions they run inside: registration is host
+    bookkeeping only, never a device sync."""
+    path = REPO / "parameter_server_tpu" / check_wrappers.LEDGER_MODULE
+    assert path.is_file(), "ledger module moved: update LEDGER_MODULE"
+    problems = check_wrappers.check_push_ack_sync_free(
+        path,
+        check_wrappers.LEDGER_SYNC_FREE_FUNCS,
+        "LEDGER_SYNC_FREE_FUNCS",
+    )
+    assert problems == [], "\n".join(problems)
+
+
+def test_catches_sync_in_ledger_submit_path(tmp_path):
+    bad = tmp_path / "bad_ledger.py"
+    bad.write_text(
+        textwrap.dedent(
+            """
+            class ApplyLedger:
+                def begin(self, table, members, rows):
+                    return object()
+
+                def mark_host(self):
+                    pass
+
+                def mark_h2d(self):
+                    pass
+
+                def submit(self, tok, ref, fallback):
+                    ref.block_until_ready()        # device sync on submit
+                    self._q.append(tok)
+
+                def overloaded(self):
+                    return bool(np.asarray(self._gauge))  # D2H sync
+            """
+        )
+    )
+    problems = check_wrappers.check_push_ack_sync_free(
+        bad,
+        check_wrappers.LEDGER_SYNC_FREE_FUNCS,
+        "LEDGER_SYNC_FREE_FUNCS",
+    )
+    assert len(problems) == 2
+    joined = "\n".join(problems)
+    assert "block_until_ready" in joined
+    assert "np.asarray" in joined
+
+
+def test_ledger_registry_fails_loudly_on_rename(tmp_path):
+    bad = tmp_path / "renamed_ledger.py"
+    bad.write_text(
+        textwrap.dedent(
+            """
+            class ApplyLedger:
+                def begin(self, table, members, rows):
+                    return object()
+            """
+        )
+    )
+    problems = check_wrappers.check_push_ack_sync_free(
+        bad,
+        check_wrappers.LEDGER_SYNC_FREE_FUNCS,
+        "LEDGER_SYNC_FREE_FUNCS",
+    )
+    assert len(problems) == 1
+    assert "missing" in problems[0]
+    assert "LEDGER_SYNC_FREE_FUNCS" in problems[0]
+
+
+def test_apply_event_taxonomy_stays_registered():
+    """main() loud-fails if the ``apply.*`` kinds are dropped from the
+    flightrec EVENTS registry; the positive half here pins that the live
+    registry still carries every required kind."""
+    from parameter_server_tpu.core import flightrec
+
+    missing = check_wrappers.REQUIRED_EVENTS - flightrec.EVENTS
+    assert not missing, f"EVENTS lost required apply kinds: {sorted(missing)}"
+    assert check_wrappers.main([]) == 0  # the repo itself stays clean

@@ -1,0 +1,238 @@
+#!/usr/bin/env python
+"""benchdiff: compare bench arms across runs, with a regression gate.
+
+The bench trajectory is recorded (``BENCH_r*.json`` wrappers per round,
+``BASELINE.md`` arm tables spliced by ``bench.py``) but until ISSUE 12
+nothing DIFFED it — a regression between rounds surfaced only if someone
+eyeballed the tables.  This tool makes the trajectory comparable:
+
+- ``BENCH_*.json``: the driver wrapper ``{"n", "cmd", "rc", "tail",
+  "parsed"}`` — ``parsed`` (when present) and every embedded
+  ``{"metric": ...}`` JSON line in ``tail`` become one sample each, keyed
+  by metric name;
+- ``BASELINE.md``: every ``<!-- BENCH-<ARM>:BEGIN/END -->`` block's
+  markdown tables become samples keyed ``<arm>/<row label>/<column>``, so
+  two revisions of the file (e.g. ``git show HEAD~1:BASELINE.md`` vs the
+  working tree) diff cell-by-cell across every recorded arm.
+
+Usage::
+
+    python tools/benchdiff.py OLD NEW [MORE...] [--fail-over PCT]
+
+The FIRST path is the baseline; each later path diffs against it.  With
+``--fail-over PCT`` the exit code is 1 when any shared metric REGRESSED by
+more than PCT percent — direction is inferred from units/names
+(throughput-like = higher is better, latency/overhead-like = lower is
+better, unknown = any move beyond PCT fails), so the gate is usable from
+CI without a per-metric config.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+#: metric-name/unit fragments marking higher-is-better series.
+_HIGHER = ("throughput", "/s", "per_s", "speedup", "examples", "rows_per")
+#: fragments marking lower-is-better series.
+_LOWER = ("ms", "us", "latency", "overhead", "pct", "%", "seconds", "bytes")
+
+_MARKER = re.compile(r"<!--\s*BENCH-([A-Z0-9_]+):BEGIN\s*-->")
+_NUM = re.compile(r"-?\d+(?:,\d{3})*(?:\.\d+)?")
+
+
+def direction(metric: str, unit: str = "") -> int:
+    """+1 higher-is-better, -1 lower-is-better, 0 unknown."""
+    probe = f"{metric} {unit}".lower()
+    for frag in _HIGHER:
+        if frag in probe:
+            return 1
+    for frag in _LOWER:
+        if frag in probe:
+            return -1
+    return 0
+
+
+def _metric_lines(text: str) -> List[dict]:
+    out = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not (line.startswith("{") and '"metric"' in line):
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict) and isinstance(rec.get("metric"), str):
+            out.append(rec)
+    return out
+
+
+def load_bench_json(path: pathlib.Path) -> Dict[str, dict]:
+    """Samples from one driver wrapper: ``{metric: {"value", "unit"}}``.
+
+    ``parsed`` (the driver's own extraction) and every embedded metric
+    line in ``tail`` contribute; on duplicates the LAST tail line wins —
+    it is the most recent emission of that arm in the run.
+    """
+    blob = json.loads(path.read_text())
+    out: Dict[str, dict] = {}
+    recs: List[dict] = []
+    if isinstance(blob, dict):
+        if isinstance(blob.get("parsed"), dict):
+            recs.append(blob["parsed"])
+        recs.extend(_metric_lines(str(blob.get("tail") or "")))
+    for rec in recs:
+        v = rec.get("value")
+        if isinstance(v, (int, float)):
+            out[rec["metric"]] = {
+                "value": float(v),
+                "unit": str(rec.get("unit") or ""),
+            }
+    return out
+
+
+def load_baseline_md(path: pathlib.Path) -> Dict[str, dict]:
+    """Samples from BASELINE.md's spliced arm blocks.
+
+    Keys are ``<arm>/<row label>/<column header>`` for every numeric cell
+    of every markdown table inside a ``BENCH-<ARM>`` marker block (the
+    leading number of a cell like ``20.6 us (62.4 GB/s)`` is the sample).
+    Stable across re-splices: bench.py rewrites whole blocks, and the
+    row/column labels are the arm's own vocabulary.
+    """
+    out: Dict[str, dict] = {}
+    text = path.read_text()
+    for m in _MARKER.finditer(text):
+        arm = m.group(1).lower()
+        end = text.find(f"<!-- BENCH-{m.group(1)}:END -->", m.end())
+        block = text[m.end(): end if end != -1 else len(text)]
+        header: List[str] = []
+        for line in block.splitlines():
+            line = line.strip()
+            if not (line.startswith("|") and line.endswith("|")):
+                continue
+            cells = [c.strip() for c in line.strip("|").split("|")]
+            if all(set(c) <= {"-", ":", " "} for c in cells):
+                continue  # the |---|---| separator row
+            if not header:
+                header = cells
+                continue
+            label = cells[0]
+            for col, cell in zip(header[1:], cells[1:]):
+                num = _NUM.search(cell)
+                if num is None:
+                    continue
+                out[f"{arm}/{label}/{col}"] = {
+                    "value": float(num.group(0).replace(",", "")),
+                    "unit": cell[num.end():].strip() or col,
+                }
+        # headline scalars outside tables: "Overhead: **-0.86%**" style
+        for hm in re.finditer(
+            r"(\w[\w -]*?):\s*\*\*(-?\d+(?:\.\d+)?)\s*([%a-zA-Z/]*)\*\*",
+            block,
+        ):
+            out[f"{arm}/{hm.group(1).strip().lower()}"] = {
+                "value": float(hm.group(2)),
+                "unit": hm.group(3),
+            }
+    return out
+
+
+def load(path_str: str) -> Dict[str, dict]:
+    path = pathlib.Path(path_str)
+    if path.suffix == ".json":
+        return load_bench_json(path)
+    return load_baseline_md(path)
+
+
+def diff(
+    old: Dict[str, dict], new: Dict[str, dict]
+) -> List[Tuple[str, float, float, float, int]]:
+    """Per shared metric: ``(name, old, new, delta_pct, direction)``."""
+    rows = []
+    for name in sorted(set(old) & set(new)):
+        a, b = old[name]["value"], new[name]["value"]
+        if a == 0:
+            continue  # delta undefined; absolute values still printed
+        pct = 100.0 * (b - a) / abs(a)
+        rows.append((name, a, b, pct, direction(name, new[name]["unit"])))
+    return rows
+
+
+def regressions(
+    rows: List[Tuple[str, float, float, float, int]], fail_over: float
+) -> List[str]:
+    """Metric names whose move counts as a regression beyond the gate."""
+    out = []
+    for name, _a, _b, pct, sign in rows:
+        worse = (
+            (sign > 0 and pct < -fail_over)       # throughput fell
+            or (sign < 0 and pct > fail_over)     # latency/overhead rose
+            or (sign == 0 and abs(pct) > fail_over)  # unknown: any move
+        )
+        if worse:
+            out.append(name)
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="diff bench arms across runs (BENCH_*.json / BASELINE.md)"
+    )
+    ap.add_argument("paths", nargs="+", help="baseline first, then candidates")
+    ap.add_argument(
+        "--fail-over", type=float, default=None, metavar="PCT",
+        help="exit 1 when any shared metric regresses by more than PCT%%",
+    )
+    args = ap.parse_args(argv)
+    if len(args.paths) < 2:
+        print("benchdiff: need a baseline and at least one candidate",
+              file=sys.stderr)
+        return 2
+    try:
+        base = load(args.paths[0])
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"benchdiff: {args.paths[0]}: {e}", file=sys.stderr)
+        return 2
+    if not base:
+        print(f"benchdiff: no metrics found in {args.paths[0]}",
+              file=sys.stderr)
+        return 2
+    failed: List[str] = []
+    for cand in args.paths[1:]:
+        try:
+            cur = load(cand)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"benchdiff: {cand}: {e}", file=sys.stderr)
+            return 2
+        rows = diff(base, cur)
+        print(f"== {args.paths[0]} -> {cand} "
+              f"({len(rows)} shared metrics) ==")
+        if not rows:
+            print("  (nothing comparable)")
+        width = max((len(r[0]) for r in rows), default=0)
+        for name, a, b, pct, sign in rows:
+            arrow = {1: "^ better", -1: "v better", 0: "?"}[sign]
+            print(
+                f"  {name:<{width}}  {a:>14.4g} -> {b:>14.4g}  "
+                f"{pct:>+8.2f}%  [{arrow}]"
+            )
+        if args.fail_over is not None:
+            bad = regressions(rows, args.fail_over)
+            for name in bad:
+                print(f"  REGRESSION beyond {args.fail_over}%: {name}")
+            failed.extend(bad)
+    if args.fail_over is not None and failed:
+        print(f"benchdiff: FAIL — {len(failed)} regression(s) beyond "
+              f"{args.fail_over}%", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -56,6 +56,16 @@ PR 6, nothing enforced:
    function (``check_push_ack_sync_free``); a registered function that
    disappears (rename) is itself a loud failure, never a vacuous pass.
 
+7. **The ApplyLedger's submit side is sync-free too.**  The device-plane
+   ledger (ISSUE 12, ``kv/ledger.py``) runs its registration methods
+   (:data:`LEDGER_SYNC_FREE_FUNCS`: ``begin``/``mark_host``/``mark_h2d``/
+   ``submit``/``overloaded``) ON the ack path — a device sync creeping into
+   any of them would reintroduce exactly the latency the ledger exists to
+   observe.  Same checker, same loud-failure stance.  The ``apply.*``
+   event kinds the ledger journals must also be present in the EVENTS
+   registry (:data:`REQUIRED_EVENTS`) — a registry edit that drops them
+   would silence the device plane while every record call still "worked".
+
 Pure-AST check (no imports of the checked modules), so it runs in any
 environment and is wired as a tier-1 test (``tests/test_wrapper_contract.py``).
 Exit code 0 = clean; 1 = violations (one line each).
@@ -119,6 +129,30 @@ SYNC_FREE_FUNCS = frozenset(
         "_push_group_combined",
     }
 )
+
+#: module holding the device-plane apply ledger, relative to the package
+#: root (ISSUE 12).
+LEDGER_MODULE = "kv/ledger.py"
+
+#: ``kv/ledger.py`` methods that run on the server's ack path (register /
+#: split-point stamping / the overload read in ``_ack_push``) — host
+#: bookkeeping only, same contract as :data:`SYNC_FREE_FUNCS`.  The reaper
+#: (``_reap_loop``/``_reap_once``/``_retire``) polls device readiness by
+#: design and is deliberately NOT registered.
+LEDGER_SYNC_FREE_FUNCS = frozenset(
+    {
+        "begin",
+        "mark_host",
+        "mark_h2d",
+        "submit",
+        "overloaded",
+    }
+)
+
+#: event kinds that MUST exist in the EVENTS registry: the device-plane
+#: taxonomy the ApplyLedger journals.  Checked in ``main`` so a registry
+#: edit dropping them fails loudly instead of silencing the device plane.
+REQUIRED_EVENTS = frozenset({"apply.submit", "apply.done", "apply.backlog"})
 
 #: ``np.<attr>`` calls that materialize a device array on the host.
 _SYNC_BANNED_NP = frozenset({"asarray", "array"})
@@ -386,14 +420,20 @@ def check_flightrec_calls(path: pathlib.Path, events: frozenset) -> List[str]:
     return problems
 
 
-def check_push_ack_sync_free(path: pathlib.Path) -> List[str]:
-    """Ban blocking device syncs inside the registered push-ack functions.
+def check_push_ack_sync_free(
+    path: pathlib.Path,
+    funcs_registry: frozenset = SYNC_FREE_FUNCS,
+    registry_name: str = "SYNC_FREE_FUNCS",
+) -> List[str]:
+    """Ban blocking device syncs inside the registered sync-free functions.
 
     Flags ``np.asarray`` / ``np.array`` / ``jax.device_get`` calls and any
-    ``.block_until_ready()`` inside a :data:`SYNC_FREE_FUNCS` function.  A
-    registry entry with no matching function definition is ITSELF a
-    violation — a rename must break this check loudly, never let the
-    contract pass vacuously against code it no longer reads.
+    ``.block_until_ready()`` inside a ``funcs_registry`` function (the
+    push-ack path by default; the ApplyLedger's submit side via
+    :data:`LEDGER_SYNC_FREE_FUNCS`).  A registry entry with no matching
+    function definition is ITSELF a violation — a rename must break this
+    check loudly, never let the contract pass vacuously against code it no
+    longer reads.
     """
     tree = ast.parse(path.read_text(), filename=str(path))
     problems: List[str] = []
@@ -401,14 +441,14 @@ def check_push_ack_sync_free(path: pathlib.Path) -> List[str]:
     for node in ast.walk(tree):
         if (
             isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
-            and node.name in SYNC_FREE_FUNCS
+            and node.name in funcs_registry
         ):
             funcs[node.name] = node
-    missing = sorted(SYNC_FREE_FUNCS - set(funcs))
+    missing = sorted(funcs_registry - set(funcs))
     if missing:
         problems.append(
-            f"{_rel(path)}: sync-free push-ack functions missing: "
-            f"{missing} — renamed?  Update SYNC_FREE_FUNCS in "
+            f"{_rel(path)}: sync-free functions missing: "
+            f"{missing} — renamed?  Update {registry_name} in "
             "tools/check_wrappers.py so the contract keeps checking the "
             "real ack path"
         )
@@ -488,11 +528,21 @@ def main(argv: List[str]) -> int:
     found_wrapper = False
     found_hot_path = 0
     found_server = False
+    found_ledger = False
     try:
         events = load_event_registry(PKG / FLIGHTREC_MODULE)
     except (OSError, ValueError) as e:
         print(f"check_wrappers: event registry unreadable: {e}", file=sys.stderr)
         return 1  # a moved/emptied registry must fail loudly, not pass
+    absent = sorted(REQUIRED_EVENTS - events)
+    if absent:
+        print(
+            f"check_wrappers: required event kinds missing from EVENTS: "
+            f"{absent} — the device-plane apply taxonomy (ISSUE 12) must "
+            "stay registered",
+            file=sys.stderr,
+        )
+        return 1
     try:
         verbs, verb_names = load_verb_registry(PKG / MANAGER_MODULE)
     except (OSError, ValueError) as e:
@@ -511,6 +561,13 @@ def main(argv: List[str]) -> int:
             if rel == SERVER_MODULE:
                 found_server = True
                 problems.extend(check_push_ack_sync_free(f))
+            if rel == LEDGER_MODULE:
+                found_ledger = True
+                problems.extend(
+                    check_push_ack_sync_free(
+                        f, LEDGER_SYNC_FREE_FUNCS, "LEDGER_SYNC_FREE_FUNCS"
+                    )
+                )
             problems.extend(check_flightrec_calls(f, events))
             problems.extend(check_control_verbs(f, verbs, verb_names))
             text = f.read_text()
@@ -526,6 +583,13 @@ def main(argv: List[str]) -> int:
         # server module moves
         print(
             "check_wrappers: kv/server.py not found — update SERVER_MODULE",
+            file=sys.stderr,
+        )
+        return 1
+    if roots == [PKG] and not found_ledger:
+        # same vacuous-pass guard for the ledger's sync-free submit side
+        print(
+            "check_wrappers: kv/ledger.py not found — update LEDGER_MODULE",
             file=sys.stderr,
         )
         return 1

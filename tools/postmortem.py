@@ -53,6 +53,7 @@ ANOMALY_KINDS = frozenset({
     "migrate.abort",
     "recv.exception",
     "slo.breach",
+    "apply.backlog",
 })
 
 

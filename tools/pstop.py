@@ -26,12 +26,25 @@ Columns:
 - ``STALE p50/p99``  worst staleness series (update version-lag, in
                 VERSIONS behind the server, not time) — ``-`` until the
                 node has recorded staleness samples;
+- ``INF``       in-flight device applies (the ApplyLedger's
+                ``inflight_bundles`` gauge, servers only);
+- ``BKLG``      age of the oldest un-retired device apply, seconds;
+- ``APLYms``    p99 of the worst ``apply.*`` total-latency digest
+                (submit -> retire), milliseconds;
+- ``DRP``       cumulative telemetry frames the aggregator dropped for
+                this node (duplicates/stale seq — control-plane health);
 - ``MIG``       active migrations (begin - commit - abort event totals);
 - ``SLO``       ``ok`` / ``BREACH:<spec,...>`` from the live engine;
 - ``FLAGS``     FleetMonitor straggler flags (``latency``, ``gap``).
 
-``render`` is a pure function over ``TelemetryAggregator.latest()``-shaped
-dicts, so tests and in-process callers can use it without a terminal.
+``--json`` swaps the table for ONE machine-readable JSON document per
+refresh (``snapshot()``'s shape: reference stamp, per-node latest rows,
+breached-node list), so downstream tooling — autoscalers, dashboards, CI
+gates — can consume the same stream pstop renders.
+
+``render`` and ``snapshot`` are pure functions over
+``TelemetryAggregator.latest()``-shaped dicts, so tests and in-process
+callers can use them without a terminal.
 """
 
 from __future__ import annotations
@@ -47,7 +60,8 @@ _CLEAR = "\x1b[2J\x1b[H"
 
 _HEADER = (
     f"{'NODE':<10} {'SEQ':>5} {'AGE':>6} {'MSG/S':>8} {'KB/S':>9} "
-    f"{'P99ms':>8} {'STALE p50/p99':>14} {'MIG':>3} {'SLO':<18} FLAGS"
+    f"{'P99ms':>8} {'STALE p50/p99':>14} {'INF':>4} {'BKLG':>6} "
+    f"{'APLYms':>7} {'DRP':>4} {'MIG':>3} {'SLO':<18} FLAGS"
 )
 
 
@@ -89,6 +103,56 @@ def _worst_staleness(row: dict) -> Optional[dict]:
     )
 
 
+def _apply_p99_ms(row: dict) -> Optional[float]:
+    """p99 of the worst ``apply.*`` TOTAL-latency digest, in ms.
+
+    Reads the device-plane ``digests`` row field (seconds axis); the
+    attribution splits (``apply_host.*``/``apply_h2d.*``/``apply_dev.*``)
+    are deliberately skipped — the column answers "how late is the device
+    plane", not "where inside the apply".
+    """
+    digs = row.get("digests")
+    if not isinstance(digs, dict):
+        return None
+    worst = None
+    for name, s in digs.items():
+        if not name.startswith("apply.") or not isinstance(s, dict):
+            continue
+        p99 = float(s.get("p99") or 0.0)
+        if worst is None or p99 > worst:
+            worst = p99
+    return None if worst is None else 1e3 * worst
+
+
+def snapshot(latest: Dict[str, dict], now: Optional[float] = None) -> dict:
+    """One machine-readable fleet snapshot (the ``--json`` payload).
+
+    Same inputs as :func:`render`; carries the raw latest rows verbatim
+    (counters, staleness, digests, ctl, breaches — nothing re-derived that
+    downstream tooling might disagree with) plus the derived roll-ups the
+    table prints: reference stamp, per-node age, breached-node list.
+    """
+    stamps = [float(r.get("t_ingest") or 0.0) for r in latest.values()]
+    ref = (max(stamps) if stamps else 0.0) if now is None else now
+    breached = sorted(
+        n for n, r in latest.items() if r.get("healthy") is False
+    )
+    return {
+        "t_ref": round(ref, 6),
+        "n_nodes": len(latest),
+        "breached": breached,
+        "nodes": {
+            n: dict(
+                latest[n],
+                age_s=round(
+                    max(ref - float(latest[n].get("t_ingest") or ref), 0.0), 3
+                ),
+            )
+            for n in sorted(latest)
+        },
+    }
+
+
 def render(latest: Dict[str, dict], now: Optional[float] = None) -> List[str]:
     """Format the fleet table; returns lines (no trailing newline).
 
@@ -120,6 +184,13 @@ def render(latest: Dict[str, dict], now: Optional[float] = None) -> List[str]:
             f"{stale['p50']:.0f}/{stale['p99']:.0f}" if stale else "-"
         )
         mig = row.get("migrations_active") or 0
+        # device plane: ApplyLedger gauges ride the cumulative counters,
+        # apply latency rides the digests field, drops ride ctl
+        counters = row.get("counters") or {}
+        inf = counters.get("inflight_bundles")
+        bklg = counters.get("backlog_age_s")
+        aply = _apply_p99_ms(row)
+        drops = (row.get("ctl") or {}).get("drops")
         healthy = row.get("healthy")
         if healthy is None:
             slo = "-"
@@ -135,6 +206,10 @@ def render(latest: Dict[str, dict], now: Optional[float] = None) -> List[str]:
             f"{msgs if msgs is not None else '-':>8} "
             f"{f'{kbs:.1f}' if kbs is not None else '-':>9} "
             f"{p99 if p99 is not None else '-':>8} {stale_s:>14} "
+            f"{int(inf) if inf is not None else '-':>4} "
+            f"{f'{bklg:.1f}' if bklg is not None else '-':>6} "
+            f"{f'{aply:.1f}' if aply is not None else '-':>7} "
+            f"{int(drops) if drops is not None else '-':>4} "
             f"{mig:>3} {slo:<18} {flags}"
         )
     lines.append(
@@ -157,6 +232,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--once", action="store_true",
         help="print one snapshot and exit (no screen clearing)",
     )
+    ap.add_argument(
+        "--json", action="store_true",
+        help="emit one machine-readable JSON snapshot per refresh "
+        "(one document per line; no screen clearing)",
+    )
     args = ap.parse_args(argv)
     if args.interval <= 0:
         print("pstop: --interval must be > 0", file=sys.stderr)
@@ -167,11 +247,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         except OSError as e:
             print(f"pstop: {e}", file=sys.stderr)
             return 1
-        lines = render(latest)
+        if args.json:
+            out = json.dumps(snapshot(latest))
+        else:
+            out = "\n".join(render(latest))
         if args.once:
-            print("\n".join(lines))
+            print(out)
             return 0
-        sys.stdout.write(_CLEAR + "\n".join(lines) + "\n")
+        if args.json:
+            sys.stdout.write(out + "\n")
+        else:
+            sys.stdout.write(_CLEAR + out + "\n")
         sys.stdout.flush()
         try:
             time.sleep(args.interval)
