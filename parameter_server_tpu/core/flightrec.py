@@ -93,6 +93,14 @@ EVENTS = frozenset({
     "apply.submit",
     "apply.done",
     "apply.backlog",
+    # read-heavy serving plane (kv/cache.py, serve/admission.py):
+    # hot-row cache hit / miss (per serving request, n = row count),
+    # cache entries dropped (watermark advance, routing-epoch adoption),
+    # read traffic shed or deferred by admission control
+    "cache.hit",
+    "cache.miss",
+    "cache.invalidate",
+    "serve.shed",
 })
 
 #: env var: when set, recv-thread exceptions auto-dump a bundle here.
@@ -384,4 +392,5 @@ def anomaly_kinds() -> frozenset:
         "recv.exception",
         "slo.breach",
         "apply.backlog",
+        "serve.shed",
     })

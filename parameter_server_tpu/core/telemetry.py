@@ -452,6 +452,24 @@ class TelemetryAggregator:
             row["bytes_per_s"] = round(d_bytes / dt, 1)
             n_ev = sum((frame.get("events") or {}).values())
             row["events_per_s"] = round(n_ev / dt, 2)
+            # serving plane (ISSUE 13): per-beat read/shed rates off the
+            # frame's counter DELTAS (sparse: only nodes that serve)
+            fc = frame.get("counters") or {}
+            d_ro = fc.get("ro_pulls")
+            if d_ro:
+                row["ro_per_s"] = round(d_ro / dt, 2)
+            d_shed = fc.get("serve_shed")
+            if d_shed:
+                row["shed_per_s"] = round(d_shed / dt, 2)
+        # serving plane: lifetime cache hit ratio off the CUMULATIVE
+        # counters (a rate would thrash at low traffic)
+        looked = cum_snapshot.get("cache_hits", 0) + cum_snapshot.get(
+            "cache_misses", 0
+        )
+        if looked:
+            row["cache_hit_pct"] = round(
+                100.0 * cum_snapshot.get("cache_hits", 0) / looked, 2
+            )
         if deliver.count:
             row["deliver_p99_ms"] = round(1e3 * deliver.percentile(0.99), 3)
             row["deliver_p50_ms"] = round(1e3 * deliver.percentile(0.50), 3)

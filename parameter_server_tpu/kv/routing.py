@@ -51,6 +51,14 @@ VERSION_KEY = "__sver__"
 #: admission control should slow down or shed load.  Same wire-contract
 #: home as the other reply keys.
 BUSY_KEY = "__busy__"
+#: request payload key: marks a PULL as read-only serving traffic (ISSUE
+#: 13).  The server answers it on the fast path — gather + one D2H per
+#: bundle, its own latency histogram — WITHOUT flushing the open push
+#: group of the bundle-batched apply engine, so a read-only pull observes
+#: the table as of dispatch, not as of the bundle's writes (the serving
+#: plane's relaxed-read contract).  Routing fences still apply: a
+#: read-only pull is never served from rows this server does not own.
+READ_ONLY_KEY = "__ro__"
 
 
 @dataclasses.dataclass(frozen=True)

@@ -46,6 +46,7 @@ from parameter_server_tpu.kv.partition import RangePartition
 from parameter_server_tpu.kv.routing import (
     BUSY_KEY,
     FENCED_KEY,
+    READ_ONLY_KEY,
     ROUTING_EPOCH_KEY,
     ROUTING_KEY,
     VERSION_KEY,
@@ -53,7 +54,7 @@ from parameter_server_tpu.kv.routing import (
 )
 from parameter_server_tpu.kv.table import KVTable
 from parameter_server_tpu.utils.keys import bucket_size
-from parameter_server_tpu.utils.trace import NULL_TRACER, Tracer
+from parameter_server_tpu.utils.trace import NULL_TRACER, LatencyHistogram, Tracer
 
 
 def _bucket(n: int) -> int:
@@ -157,6 +158,14 @@ class KVServer(Customer):
         #: dashboard counters
         self.pushes = 0
         self.pulls = 0
+        #: serving plane (ISSUE 13): read-only fast-path pulls answered,
+        #: and their per-table server-side latency (dispatch -> reply built,
+        #: including the D2H readback — the histogram the ``ro-p99`` SLO
+        #: watches).  Recv-thread-only, like every other counter here.
+        self.ro_pulls = 0
+        self.ro_hist: Dict[str, LatencyHistogram] = {
+            t: LatencyHistogram() for t in table_cfgs
+        }
         self.fenced_rejects = 0
         self.rows_migrated_in = 0
         self.rows_migrated_out = 0
@@ -256,6 +265,11 @@ class KVServer(Customer):
         The worker's retry loop keys on ``__fenced__`` (a real handler error
         must still raise) and adopts the attached routing iff it is newer
         than what it holds — rejected, not lost.
+
+        ISSUE 13: fences also carry the shard's ``__sver__`` (and the table
+        name the fence payload would otherwise drop), so a reject still
+        refreshes the worker's cache-invalidation watermark — a fenced
+        worker learns about writes it raced with from the reject itself.
         """
         self.fenced_rejects += 1
         flightrec.record(
@@ -263,14 +277,16 @@ class KVServer(Customer):
             epoch=self.routing.epoch, why=why[:120],
         )
         reply = msg.reply()
-        reply.task = dataclasses.replace(
-            msg.task,
-            payload={
-                "__error__": why,
-                FENCED_KEY: True,
-                ROUTING_KEY: self.routing.to_payload(),
-            },
-        )
+        payload = {
+            "__error__": why,
+            FENCED_KEY: True,
+            ROUTING_KEY: self.routing.to_payload(),
+        }
+        tname = msg.task.payload.get("table")
+        if tname in self._seg_versions:
+            payload["table"] = tname
+            payload[VERSION_KEY] = self.version_max(tname)
+        reply.task = dataclasses.replace(msg.task, payload=payload)
         return reply
 
     # -- staleness version clock (ISSUE 10) -----------------------------------
@@ -358,6 +374,7 @@ class KVServer(Customer):
         """Migration/fence counters, Dashboard-mergeable (utils.metrics)."""
         out = {
             "fenced_rejects": self.fenced_rejects,
+            "ro_pulls": self.ro_pulls,
             "rows_migrated_in": self.rows_migrated_in,
             "rows_migrated_out": self.rows_migrated_out,
             "migration_freeze_s": round(self.migration_freeze_s, 6),
@@ -376,10 +393,16 @@ class KVServer(Customer):
 
     def latency_digests(self) -> Dict[str, dict]:
         """Device-plane apply attribution digests for the telemetry
-        publisher (``apply.<t>`` total + host/h2d/dev splits, cumulative)."""
-        return (
+        publisher (``apply.<t>`` total + host/h2d/dev splits, cumulative),
+        plus the serving plane's read-only pull latency (``ro_pull.<t>``,
+        the ``ro-p99`` SLO's metric)."""
+        out = (
             self.ledger.latency_digests() if self.ledger is not None else {}
         )
+        for t, hist in self.ro_hist.items():
+            if hist.count:
+                out[f"ro_pull.{t}"] = hist.to_dict()
+        return out
 
     # -- request handling -----------------------------------------------------
     def _span_attrs(self, msg: Message, tname: str) -> dict:
@@ -581,6 +604,27 @@ class KVServer(Customer):
         sver = int(ver[segs].max()) if segs.size else self.version_max(tname)
         return rows, n, sver
 
+    def _pull_ro_device(
+        self, msg: Message, tname: str, ids_np: np.ndarray, segs: np.ndarray
+    ) -> Tuple[jax.Array, int, int]:
+        """Read-only fast-path gather (ISSUE 13): same device dispatch as
+        ``_pull_device`` but on the serving books — its own counter and
+        per-table latency histogram, and (in the bundle path) NO flush of
+        the open push group.  Skips everything a write needs: optimizer,
+        dup policy, ApplyLedger, replica forwarding."""
+        table = self.tables[tname]
+        n = int(ids_np.shape[0])
+        b = _bucket(n)
+        ids = jnp.asarray(self._pad_ids(table, ids_np, b))
+        with self.tracer.span(
+            "kv.server.pull_ro", **self._span_attrs(msg, tname)
+        ):
+            rows = table.pull(ids)
+        self.ro_pulls += 1
+        ver = self._seg_versions[tname]
+        sver = int(ver[segs].max()) if segs.size else self.version_max(tname)
+        return rows, n, sver
+
     def handle_request(self, msg: Message) -> Message:
         if msg.task.kind == TaskKind.CONTROL:
             return self._handle_control(msg)
@@ -591,6 +635,15 @@ class KVServer(Customer):
         if msg.task.kind == TaskKind.PUSH:
             return self._handle_push_single(msg, tname, ids_np, kn, segs)
         elif msg.task.kind == TaskKind.PULL:
+            if msg.task.payload.get(READ_ONLY_KEY):
+                t0 = time.perf_counter()
+                rows, n, sver = self._pull_ro_device(msg, tname, ids_np, segs)
+                if self.device_replies:
+                    vals = [rows[:n]]
+                else:
+                    vals = [np.asarray(rows)[:n]]
+                self.ro_hist[tname].record(time.perf_counter() - t0)
+                return self._stamp_version(msg, msg.reply(values=vals), sver)
             rows, n, sver = self._pull_device(msg, tname, ids_np, segs)
             if self.device_replies:
                 return self._stamp_version(msg, msg.reply(values=[rows[:n]]), sver)
@@ -627,9 +680,17 @@ class KVServer(Customer):
         failing member answers ``__error__``; the rest of the bundle
         proceeds), except that a grouped device apply fails its whole group
         — the group is one device call by design.
+
+        Read-only pulls (``__ro__``, ISSUE 13) are the exception to the
+        flush rule: they deliberately do NOT flush the open push group —
+        the serving plane's relaxed-read contract is "the table as of
+        dispatch", so a read-only member may observe the shard WITHOUT the
+        writes riding the same bundle.  They defer to their own single
+        ``jax.device_get`` and record into the ``ro_pull.<t>`` histogram.
         """
         replies: List[Optional[Message]] = [None] * len(msgs)
         pulls: List[tuple] = []  # (i, msg, rows, n, sver)
+        ro: List[tuple] = []  # (i, msg, tname, rows, n, sver, t0)
         group: List[tuple] = []  # (i, msg, tname, ids_np, kn, segs)
 
         def flush_group() -> None:
@@ -673,6 +734,14 @@ class KVServer(Customer):
                         flush_group()
                     group.append((i, msg, tname, ids_np, kn, segs))
                 elif msg.task.kind == TaskKind.PULL:
+                    if msg.task.payload.get(READ_ONLY_KEY):
+                        # NO flush_group(): relaxed read, see docstring
+                        t0 = time.perf_counter()
+                        rows, n, sver = self._pull_ro_device(
+                            msg, tname, ids_np, segs
+                        )
+                        ro.append((i, msg, tname, rows, n, sver, t0))
+                        continue
                     flush_group()  # the pull must see prior member pushes
                     rows, n, sver = self._pull_device(msg, tname, ids_np, segs)
                     pulls.append((i, msg, rows, n, sver))
@@ -690,6 +759,7 @@ class KVServer(Customer):
                 replies[i] = self._error_reply(msg, e)
         flush_group()
         self._finish_pulls(pulls, replies)
+        self._finish_ro_pulls(ro, replies)
         return replies
 
     def _finish_pulls(self, pulls: List[tuple], replies: List) -> None:
@@ -706,6 +776,25 @@ class KVServer(Customer):
         host = jax.device_get([rows for _, _, rows, _, _ in pulls])
         for (i, m, _, n, sver), h in zip(pulls, host):
             replies[i] = self._stamp_version(m, m.reply(values=[h[:n]]), sver)
+
+    def _finish_ro_pulls(self, ro: List[tuple], replies: List) -> None:
+        """Materialize deferred READ-ONLY pull replies: the bundle's other
+        single ``jax.device_get``, with per-member serving latency recorded
+        from each member's dispatch time."""
+        if not ro:
+            return
+        if self.device_replies:
+            for i, m, tname, rows, n, sver, t0 in ro:
+                replies[i] = self._stamp_version(
+                    m, m.reply(values=[rows[:n]]), sver
+                )
+                self.ro_hist[tname].record(time.perf_counter() - t0)
+            return
+        host = jax.device_get([rows for _, _, _, rows, _, _, _ in ro])
+        done = time.perf_counter()
+        for (i, m, tname, _, n, sver, t0), h in zip(ro, host):
+            replies[i] = self._stamp_version(m, m.reply(values=[h[:n]]), sver)
+            self.ro_hist[tname].record(done - t0)
 
     def _apply_push_group(self, group: List[tuple], replies: List) -> None:
         """One device apply for a run of same-table PUSHes.

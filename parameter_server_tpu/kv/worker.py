@@ -40,12 +40,15 @@ import jax.numpy as jnp
 import numpy as np
 
 from parameter_server_tpu.config import TableConfig
+from parameter_server_tpu.core import flightrec
 from parameter_server_tpu.core.messages import Message, Task, TaskKind, server_id
 from parameter_server_tpu.core.postoffice import Customer, Postoffice
+from parameter_server_tpu.kv.cache import HotRowCache
 from parameter_server_tpu.kv.partition import RangePartition
 from parameter_server_tpu.kv.routing import (
     BUSY_KEY,
     FENCED_KEY,
+    READ_ONLY_KEY,
     ROUTING_EPOCH_KEY,
     ROUTING_KEY,
     VERSION_KEY,
@@ -76,6 +79,7 @@ class KVWorker(Customer):
         routing: Optional[RoutingTable] = None,
         max_fence_retries: int = 8,
         fence_backoff: float = 0.02,
+        cache: Optional[HotRowCache] = None,
     ) -> None:
         """``retry_on_timeout``: when a pull's deadline expires (dead or
         mid-promotion server), cancel the stuck task and re-issue it ONCE
@@ -87,7 +91,12 @@ class KVWorker(Customer):
         ``routing``: initial routing table (defaults to the uniform epoch-0
         split).  The worker converges to newer tables lazily off fence
         rejects and eagerly off scheduler ROUTING broadcasts (wire either
-        into :meth:`adopt_routing`)."""
+        into :meth:`adopt_routing`).
+
+        ``cache``: a :class:`~parameter_server_tpu.kv.cache.HotRowCache`
+        turns this worker into a serving node (ISSUE 13): :meth:`pull_serve`
+        answers hot keys locally, every stamped reply refreshes the cache's
+        invalidation watermark, and routing adoption drops all entries."""
         super().__init__(name, post)
         #: host-side span recorder (Push/Pull latency histograms, SURVEY §5)
         self.tracer = tracer
@@ -133,6 +142,28 @@ class KVWorker(Customer):
         #: monotonic stamp of the last busy hint per server — the admission
         #: signal a throttling training loop polls via :meth:`server_busy`
         self._busy_last: Dict[str, float] = {}
+        # -- read-heavy serving plane (ISSUE 13) -----------------------------
+        #: hot-row cache; None = this worker does not serve reads
+        self.cache = cache
+        #: table -> (TableRouting identity, per-segment owner-code vector);
+        #: memoizes the serve path's owner interning per adopted routing
+        self._serve_codes: Dict[str, tuple] = {}
+
+    def _serve_owner_codes(self, table: str, tr, cache) -> np.ndarray:
+        """Owner :meth:`HotRowCache.server_code` per segment of ``tr``.
+
+        Identity-keyed memo: :meth:`adopt_routing` replaces routing objects
+        wholesale, so ``ent[0] is tr`` is exact — no epoch bookkeeping.
+        """
+        ent = self._serve_codes.get(table)
+        if ent is not None and ent[0] is tr:
+            return ent[1]
+        codes = np.asarray(
+            [cache.server_code(server_id(int(o))) for o in tr.owners],
+            dtype=np.int32,
+        )
+        self._serve_codes[table] = (tr, codes)
+        return codes
 
     # -- routing --------------------------------------------------------------
     def adopt_routing(self, routing) -> bool:
@@ -150,19 +181,29 @@ class KVWorker(Customer):
             routing = RoutingTable.from_payload(routing)
         with self._routing_lock:
             if routing.epoch <= self.routing.epoch:
-                return False
-            self.routing = routing
-            return True
+                adopted = False
+            else:
+                self.routing = routing
+                adopted = True
+        if adopted and self.cache is not None:
+            # serving plane: entries are keyed by owner, so most would miss
+            # anyway (owner changed) — but a range that moved AND moved back
+            # across epochs could alias, so adoption drops everything.
+            self.cache.invalidate_all(reason="routing-epoch")
+        return adopted
 
     def counters(self) -> dict:
         """Retry counters, Dashboard-mergeable (utils.metrics)."""
-        return {
+        out = {
             "pull_retries": self.pull_retries,
             "push_retries": self.push_retries,
             "refresh_retries": self.refresh_retries,
             "staleness_samples": self.staleness_samples,
             "busy_hints": self.busy_hints,
         }
+        if self.cache is not None:
+            out.update(self.cache.counters())
+        return out
 
     def server_busy(self, server: str, within_s: float = 1.0) -> bool:
         """True if ``server`` stamped ``__busy__`` onto an ack within the
@@ -198,20 +239,34 @@ class KVWorker(Customer):
             sver = payload.get(VERSION_KEY)
             table = payload.get("table")
             if sver is not None and table is not None:
+                if self.cache is not None:
+                    # serving plane: EVERY stamped reply — push ack, pull
+                    # reply, and (ISSUE 13) fence reject — raises the
+                    # cache-invalidation watermark for (table, server)
+                    self.cache.observe(table, msg.sender, int(sver))
                 key = (table, msg.sender)
-                with self._staleness_lock:
-                    if msg.task.kind == TaskKind.PUSH:
-                        prev = self._last_push_version.get(key, 0)
-                        if sver > prev:
-                            self._last_push_version[key] = int(sver)
-                    elif msg.task.kind == TaskKind.PULL:
-                        last = self._last_push_version.get(key)
-                        if last is not None:
-                            hist = self._staleness.get(key)
-                            if hist is None:
-                                hist = self._staleness[key] = LatencyHistogram()
-                            hist.record(float(max(int(sver) - last, 0)))
-                            self.staleness_samples += 1
+                if payload.get(FENCED_KEY):
+                    # fence: the request was REJECTED, so the stamp must not
+                    # advance last-push bookkeeping (the push never applied)
+                    # nor count as a served-pull staleness sample — it only
+                    # feeds the watermark above
+                    pass
+                else:
+                    with self._staleness_lock:
+                        if msg.task.kind == TaskKind.PUSH:
+                            prev = self._last_push_version.get(key, 0)
+                            if sver > prev:
+                                self._last_push_version[key] = int(sver)
+                        elif msg.task.kind == TaskKind.PULL:
+                            last = self._last_push_version.get(key)
+                            if last is not None:
+                                hist = self._staleness.get(key)
+                                if hist is None:
+                                    hist = self._staleness[key] = (
+                                        LatencyHistogram()
+                                    )
+                                hist.record(float(max(int(sver) - last, 0)))
+                                self.staleness_samples += 1
         except Exception:  # noqa: BLE001 — observability must never lose
             pass  # the reply itself
         super()._on_response(msg)
@@ -411,15 +466,30 @@ class KVWorker(Customer):
             }
 
     # -- pull ---------------------------------------------------------------
-    def pull(self, table: str, keys: np.ndarray) -> int:
-        """Request weights for ``keys``; fetch with :meth:`pull_result`."""
+    def pull(self, table: str, keys: np.ndarray, *, read_only: bool = False) -> int:
+        """Request weights for ``keys``; fetch with :meth:`pull_result`.
+
+        ``read_only=True`` stamps the serving plane's ``__ro__`` flag: the
+        server answers on the read-only fast path (ISSUE 13) — relaxed
+        reads that may NOT observe writes coalesced into the same wire
+        bundle.  Training pulls must keep the default.
+        """
         slots, inverse, _n = localize_to_slots(
             keys, self.localizers[table], min_bucket=self.min_bucket
         )
-        return self._submit_pull(table, slots, inverse, keys.shape)
+        return self._submit_pull(
+            table, slots, inverse, keys.shape, read_only=read_only
+        )
 
     def _submit_pull(
-        self, table, slots, inverse, shape, positions: Optional[np.ndarray] = None
+        self,
+        table,
+        slots,
+        inverse,
+        shape,
+        positions: Optional[np.ndarray] = None,
+        *,
+        read_only: bool = False,
     ) -> int:
         tctx = self._trace_ctx()
         routing = self.routing
@@ -428,20 +498,21 @@ class KVWorker(Customer):
         sub = slots[positions]
         msgs = []
         order = {}
+        payload = {
+            "table": table,
+            "__trace__": tctx,
+            ROUTING_EPOCH_KEY: routing.epoch,
+        }
+        if read_only:
+            payload[READ_ONLY_KEY] = True
         for s, rel, ids in routing.slice_ids(table, sub):
             abs_pos = positions[rel]
             order[server_id(s)] = abs_pos
             msgs.append(
                 Message(
-                    task=Task(
-                        TaskKind.PULL,
-                        self.name,
-                        payload={
-                            "table": table,
-                            "__trace__": tctx,
-                            ROUTING_EPOCH_KEY: routing.epoch,
-                        },
-                    ),
+                    # fresh dict per leg: payloads must never be shared
+                    # across messages (a Loopback reply path may alias them)
+                    task=Task(TaskKind.PULL, self.name, payload=dict(payload)),
                     recver=server_id(s),
                     keys=ids.astype(np.int32),
                 )
@@ -457,6 +528,7 @@ class KVWorker(Customer):
             # retained so deadline/fence retries can re-issue subsets
             "slots": slots,
             "trace": tctx["tid"],
+            "ro": read_only,
         }
         return ts
 
@@ -484,6 +556,7 @@ class KVWorker(Customer):
                 plan["inverse"],
                 plan["shape"],
                 positions=pos,
+                read_only=plan.get("ro", False),
             )
             tid = self._pull_plans[ts].get("trace")
             with self.tracer.span("kv.pull.wait", ts=ts, retry=1, trace=tid):
@@ -496,9 +569,12 @@ class KVWorker(Customer):
         return plan, responses, errs
 
     def _pull_pairs(self, ts: int, timeout: Optional[float]) -> tuple:
-        """Resolve pull ``ts`` into ``(plan, [(positions, rows)])``, looping
-        over routing fences: fenced legs adopt the attached table and only
-        their positions are re-pulled (under the NEW epoch)."""
+        """Resolve pull ``ts`` into ``(plan, [(positions, rows, sver,
+        sender)])``, looping over routing fences: fenced legs adopt the
+        attached table and only their positions are re-pulled (under the
+        NEW epoch).  ``sver``/``sender`` let :meth:`pull_serve` stamp cache
+        inserts with the version EACH REPLY actually carried — never the
+        watermark at insert time, which may have advanced concurrently."""
         pairs: list = []
         first_plan = None
         for attempt in range(self.max_fence_retries + 1):
@@ -516,7 +592,15 @@ class KVWorker(Customer):
                     f"pull ts={ts} incomplete: {len(responses)}/"
                     f"{len(plan['order'])} servers answered (dead server?)"
                 )
-            pairs.extend((plan["order"][r.sender], r.values[0]) for r in data)
+            pairs.extend(
+                (
+                    plan["order"][r.sender],
+                    r.values[0],
+                    r.task.payload.get(VERSION_KEY),
+                    r.sender,
+                )
+                for r in data
+            )
             if not fenced:
                 return first_plan, pairs
             pos = np.sort(np.concatenate(fenced))
@@ -529,6 +613,7 @@ class KVWorker(Customer):
                 first_plan["inverse"],
                 first_plan["shape"],
                 positions=pos,
+                read_only=first_plan.get("ro", False),
             )
         raise RuntimeError(
             f"pull of {first_plan['table']!r}: routing fence retries "
@@ -547,7 +632,7 @@ class KVWorker(Customer):
         """
         if len(pairs) != 1:
             return None
-        pos, rows = pairs[0]
+        pos, rows = pairs[0][0], pairs[0][1]
         pos = np.asarray(pos)
         if pos.size == n_slots and np.array_equal(pos, np.arange(n_slots)):
             return rows
@@ -568,7 +653,7 @@ class KVWorker(Customer):
             uniq_rows = np.asarray(sole, dtype=cfg.dtype).reshape(-1, cfg.dim)
         else:
             uniq_rows = np.zeros((plan["n_slots"], cfg.dim), dtype=cfg.dtype)
-            for pos, rows in pairs:
+            for pos, rows, *_meta in pairs:
                 uniq_rows[pos] = np.asarray(rows).reshape(-1, cfg.dim)
         out = uniq_rows[plan["inverse"]]
         if cfg.dim == 1:
@@ -590,7 +675,7 @@ class KVWorker(Customer):
             uniq = jnp.asarray(sole, jnp.dtype(cfg.dtype)).reshape(-1, cfg.dim)
         else:
             uniq = jnp.zeros((plan["n_slots"], cfg.dim), jnp.dtype(cfg.dtype))
-            for pos, rows in pairs:
+            for pos, rows, *_meta in pairs:
                 rows = jnp.asarray(rows).reshape(-1, cfg.dim)
                 uniq = uniq.at[jnp.asarray(pos)].set(rows)
         out = jnp.take(uniq, jnp.asarray(plan["inverse"]), axis=0)
@@ -602,6 +687,118 @@ class KVWorker(Customer):
         self, table: str, keys: np.ndarray, timeout: Optional[float] = None
     ) -> np.ndarray:
         return self.pull_result(self.pull(table, keys), timeout)
+
+    # -- read-heavy serving plane (ISSUE 13) ---------------------------------
+    def pull_serve(
+        self, table: str, keys: np.ndarray, timeout: Optional[float] = None
+    ) -> np.ndarray:
+        """Serve a read: hot-row cache first, read-only RPC for the misses.
+
+        Same output contract as :meth:`pull_sync`, but every key the cache
+        holds at a fresh version (entry ``__sver__`` >= the owner's observed
+        watermark) is answered locally; only the misses go on the wire —
+        stamped ``__ro__``, so the server answers them on the fast path.
+        Fetched rows are inserted at the version THEIR reply carried, which
+        is what keeps the bounded-staleness contract exact under races.
+        Without a cache this degrades to a plain read-only pull.
+        """
+        keys = np.asarray(keys)
+        cache = self.cache
+        if cache is None:
+            return self.pull_result(
+                self.pull(table, keys, read_only=True), timeout
+            )
+        cfg = self.table_cfgs[table]
+        with self.tracer.span("kv.pull_serve", table=table, n=int(keys.size)):
+            # No dedup/sort on the hit path: ``Localizer.assign`` is
+            # elementwise, so probe one slot PER POSITION (duplicates probe
+            # twice — vectorized, cheaper than a ``np.unique``) and the
+            # inverse is the identity.  Only the miss subset pays the sort
+            # that ``Routing.slice_ids`` requires.
+            loc = self.localizers[table]
+            slots = loc.assign(
+                np.ascontiguousarray(keys, dtype=np.uint64).ravel()
+            )
+            inverse = np.arange(slots.shape[0], dtype=np.int32)
+            tr = self.routing.tables[table]
+            grows = tr.rows
+            n_slots = int(slots.shape[0])
+            rows_out = np.zeros((n_slots, cfg.dim), dtype=cfg.dtype)
+            real = np.flatnonzero(slots < grows)
+            rslots = slots[real].astype(np.int64, copy=False)
+            seg = np.searchsorted(
+                np.asarray(tr.offsets, dtype=np.int64), rslots, side="right"
+            ) - 1
+            seg = np.clip(seg, 0, len(tr.owners) - 1)
+            # per-segment owner codes interned once per adopted routing
+            # table (identity-keyed: adoption replaces the object), so the
+            # batch compare inside the cache is pure vector ops
+            owner_codes = self._serve_owner_codes(table, tr, cache)[seg]
+            hit, hit_rows = cache.lookup_many(table, rslots, owner_codes)
+            n_hit = int(hit.sum())
+            if n_hit:
+                rows_out[real[hit]] = hit_rows
+                flightrec.record(
+                    "cache.hit", node=self.post.node_id, table=table,
+                    n=n_hit,
+                )
+            if n_hit < int(real.shape[0]):
+                miss = ~hit
+                # slice_ids routes by searchsorted: subset must be sorted
+                pos = real[miss][np.argsort(rslots[miss], kind="stable")]
+                flightrec.record(
+                    "cache.miss", node=self.post.node_id, table=table,
+                    n=int(pos.shape[0]),
+                )
+                ts = self._submit_pull(
+                    table, slots, inverse, keys.shape,
+                    positions=pos, read_only=True,
+                )
+                _plan, pairs = self._pull_pairs(ts, timeout)
+                for p, rows, sver, sender in pairs:
+                    rows = np.asarray(rows, dtype=cfg.dtype).reshape(
+                        -1, cfg.dim
+                    )
+                    rows_out[p] = rows
+                    ids = slots[p]
+                    realm = ids < grows
+                    if sver is not None and realm.any():
+                        cache.insert(
+                            table, ids[realm], rows[realm], int(sver), sender
+                        )
+            out = rows_out[inverse]
+        if cfg.dim == 1:
+            return out.reshape(keys.shape)
+        return out.reshape(keys.shape + (cfg.dim,))
+
+    def pull_stale(
+        self, table: str, keys: np.ndarray
+    ) -> Optional[np.ndarray]:
+        """Serve entirely from cache IGNORING freshness — the "stale" shed
+        policy's degraded answer during overload.  Returns None unless
+        every real key is cached (a partially-stale answer would mix
+        freshness classes invisibly); never touches the wire."""
+        cache = self.cache
+        if cache is None:
+            return None
+        keys = np.asarray(keys)
+        cfg = self.table_cfgs[table]
+        slots, inverse, _n = localize_to_slots(
+            keys, self.localizers[table], min_bucket=self.min_bucket
+        )
+        grows = self.routing.tables[table].rows
+        rows_out = np.zeros((int(slots.shape[0]), cfg.dim), dtype=cfg.dtype)
+        for j, sl in enumerate(np.asarray(slots).tolist()):
+            if int(sl) >= grows:
+                continue
+            hit = cache.lookup_stale(table, int(sl))
+            if hit is None:
+                return None
+            rows_out[j] = hit[0]
+        out = rows_out[inverse]
+        if cfg.dim == 1:
+            return out.reshape(keys.shape)
+        return out.reshape(keys.shape + (cfg.dim,))
 
     def push_sync(
         self,

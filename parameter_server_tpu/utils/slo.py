@@ -356,6 +356,42 @@ def device_plane_specs(
     ]
 
 
+def serving_plane_specs(
+    table: str = "w",
+    *,
+    ro_p99_ms: float = 50.0,
+    backlog_bundles: int = 8,
+    window_s: float = 10.0,
+) -> List[SloSpec]:
+    """The ISSUE-13 serving-plane SLO pair, for admission control.
+
+    - ``ro-p99``: windowed p99 of the ``ro_pull.<table>`` digest — the
+      server-side latency of the read-only fast path, published through
+      the same telemetry ``digests`` channel as the apply digests;
+    - ``apply-backlog``: the ``inflight_bundles`` gauge again.  Serving
+      shares the device with training, so write backlog IS a serving
+      overload signal: breaching either flips ``SloEngine.healthy(node)``
+      false and the :class:`~parameter_server_tpu.serve.admission.
+      AdmissionController` starts shedding within one telemetry beat.
+    """
+    return [
+        SloSpec(
+            "ro-p99",
+            f"ro_pull.{table}",
+            ro_p99_ms,
+            source="p99",
+            window_s=window_s,
+        ),
+        SloSpec(
+            "apply-backlog",
+            "inflight_bundles",
+            float(backlog_bundles),
+            source="gauge",
+            window_s=window_s,
+        ),
+    ]
+
+
 def _delta_hist(first: dict, last: dict) -> LatencyHistogram:
     """Histogram of the samples recorded BETWEEN two cumulative digests.
 

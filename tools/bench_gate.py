@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""bench_gate: the tier-1-adjacent perf-regression gate over BASELINE.md.
+
+``bench.py``'s arms (``--wire``/``--obs``/``--apply``/``--devobs``/
+``--serve``) auto-record their headline numbers into marker blocks of
+``BASELINE.md``; ``tools/benchdiff.py`` can diff two revisions of that
+file cell-by-cell.  This tool closes the loop as a GATE a CI job (or a
+pre-commit hook) runs after re-benching:
+
+    python tools/bench_gate.py                 # HEAD vs working tree, 10%
+    python tools/bench_gate.py --fail-over 25  # looser gate
+    python tools/bench_gate.py --baseline v1.2 # gate against a tag
+
+It extracts the BASELINE.md of ``--baseline`` (default ``HEAD``) via
+``git show``, diffs it against the working-tree file with benchdiff's
+direction-aware comparison, and exits 1 when any shared metric regressed
+beyond ``--fail-over`` percent.
+
+Escape hatch — intentional re-baselines:
+
+Perf numbers legitimately move when the code means them to (a new arm, a
+machine change, an optimization that trades one metric for another).  Two
+sanctioned ways to pass the gate on purpose:
+
+- set ``PS_BENCH_REBASE=1`` in the environment: the gate still PRINTS the
+  full diff but exits 0, stamping ``REBASE`` so the CI log records that
+  the move was deliberate;
+- or simply commit the regenerated BASELINE.md first — the gate compares
+  against the committed revision, so a committed re-baseline IS the new
+  baseline.
+
+Exit codes: 0 pass (or rebase), 1 regression, 2 usage/environment error
+(missing file, not a git checkout, unknown revision).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import pathlib
+import subprocess
+import sys
+import tempfile
+from typing import List, Optional
+
+_REPO = pathlib.Path(__file__).resolve().parent.parent
+
+sys.path.insert(0, str(_REPO / "tools"))
+import benchdiff  # noqa: E402  (sibling tool, not a package)
+
+
+def baseline_text(rev: str, repo: pathlib.Path) -> str:
+    """BASELINE.md as of git revision ``rev`` (raises on unknown rev)."""
+    return subprocess.run(
+        ["git", "show", f"{rev}:BASELINE.md"],
+        cwd=repo,
+        check=True,
+        capture_output=True,
+        text=True,
+    ).stdout
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="gate the working-tree BASELINE.md against a committed one"
+    )
+    ap.add_argument(
+        "--baseline", default="HEAD",
+        help="git revision holding the reference BASELINE.md "
+        "(default: %(default)s)",
+    )
+    ap.add_argument(
+        "--fail-over", type=float, default=10.0, metavar="PCT",
+        help="regression tolerance in percent (default: %(default)s)",
+    )
+    ap.add_argument(
+        "--file", default=None,
+        help="candidate file (default: <repo>/BASELINE.md working tree)",
+    )
+    args = ap.parse_args(argv)
+    cand = pathlib.Path(args.file) if args.file else _REPO / "BASELINE.md"
+    if not cand.exists():
+        print(f"bench_gate: {cand} not found", file=sys.stderr)
+        return 2
+    try:
+        ref = baseline_text(args.baseline, _REPO)
+    except (subprocess.CalledProcessError, OSError) as e:
+        detail = getattr(e, "stderr", "") or str(e)
+        print(f"bench_gate: git show {args.baseline}:BASELINE.md failed: "
+              f"{detail.strip()}", file=sys.stderr)
+        return 2
+    # benchdiff consumes paths; give the committed text a real file
+    with tempfile.NamedTemporaryFile(
+        "w", suffix=".md", prefix="baseline_ref_", delete=False
+    ) as tf:
+        tf.write(ref)
+        ref_path = tf.name
+    try:
+        rc = benchdiff.main(
+            [ref_path, str(cand), "--fail-over", str(args.fail_over)]
+        )
+    finally:
+        os.unlink(ref_path)
+    if rc == 1 and os.environ.get("PS_BENCH_REBASE"):
+        print(
+            "bench_gate: REBASE — regressions accepted via PS_BENCH_REBASE=1"
+        )
+        return 0
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

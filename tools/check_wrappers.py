@@ -150,9 +150,19 @@ LEDGER_SYNC_FREE_FUNCS = frozenset(
 )
 
 #: event kinds that MUST exist in the EVENTS registry: the device-plane
-#: taxonomy the ApplyLedger journals.  Checked in ``main`` so a registry
-#: edit dropping them fails loudly instead of silencing the device plane.
-REQUIRED_EVENTS = frozenset({"apply.submit", "apply.done", "apply.backlog"})
+#: taxonomy the ApplyLedger journals (ISSUE 12) plus the serving-plane
+#: taxonomy the hot-row cache and admission control journal (ISSUE 13).
+#: Checked in ``main`` so a registry edit dropping them fails loudly
+#: instead of silencing either plane.
+REQUIRED_EVENTS = frozenset({
+    "apply.submit",
+    "apply.done",
+    "apply.backlog",
+    "cache.hit",
+    "cache.miss",
+    "cache.invalidate",
+    "serve.shed",
+})
 
 #: ``np.<attr>`` calls that materialize a device array on the host.
 _SYNC_BANNED_NP = frozenset({"asarray", "array"})

@@ -54,6 +54,7 @@ ANOMALY_KINDS = frozenset({
     "recv.exception",
     "slo.breach",
     "apply.backlog",
+    "serve.shed",
 })
 
 

@@ -31,6 +31,12 @@ Columns:
 - ``BKLG``      age of the oldest un-retired device apply, seconds;
 - ``APLYms``    p99 of the worst ``apply.*`` total-latency digest
                 (submit -> retire), milliseconds;
+- ``RO/S``      read-only fast-path pulls answered per second (servers)
+                — the serving plane's throughput column;
+- ``HIT%``      lifetime hot-row cache hit ratio (serving workers) —
+                ``-`` until the node has looked up at least one key;
+- ``SHED/S``    reads shed by admission control per second (serving
+                workers; the ``serve.shed`` event rate);
 - ``DRP``       cumulative telemetry frames the aggregator dropped for
                 this node (duplicates/stale seq — control-plane health);
 - ``MIG``       active migrations (begin - commit - abort event totals);
@@ -61,7 +67,8 @@ _CLEAR = "\x1b[2J\x1b[H"
 _HEADER = (
     f"{'NODE':<10} {'SEQ':>5} {'AGE':>6} {'MSG/S':>8} {'KB/S':>9} "
     f"{'P99ms':>8} {'STALE p50/p99':>14} {'INF':>4} {'BKLG':>6} "
-    f"{'APLYms':>7} {'DRP':>4} {'MIG':>3} {'SLO':<18} FLAGS"
+    f"{'APLYms':>7} {'RO/S':>7} {'HIT%':>5} {'SHED/S':>7} "
+    f"{'DRP':>4} {'MIG':>3} {'SLO':<18} FLAGS"
 )
 
 
@@ -190,6 +197,11 @@ def render(latest: Dict[str, dict], now: Optional[float] = None) -> List[str]:
         inf = counters.get("inflight_bundles")
         bklg = counters.get("backlog_age_s")
         aply = _apply_p99_ms(row)
+        # serving plane: rates derived by the aggregator per beat; the hit
+        # ratio is lifetime-cumulative (see core/telemetry.py)
+        ro_s = row.get("ro_per_s")
+        hitp = row.get("cache_hit_pct")
+        shed_s = row.get("shed_per_s")
         drops = (row.get("ctl") or {}).get("drops")
         healthy = row.get("healthy")
         if healthy is None:
@@ -209,6 +221,9 @@ def render(latest: Dict[str, dict], now: Optional[float] = None) -> List[str]:
             f"{int(inf) if inf is not None else '-':>4} "
             f"{f'{bklg:.1f}' if bklg is not None else '-':>6} "
             f"{f'{aply:.1f}' if aply is not None else '-':>7} "
+            f"{f'{ro_s:.1f}' if ro_s is not None else '-':>7} "
+            f"{f'{hitp:.1f}' if hitp is not None else '-':>5} "
+            f"{f'{shed_s:.1f}' if shed_s is not None else '-':>7} "
             f"{int(drops) if drops is not None else '-':>4} "
             f"{mig:>3} {slo:<18} {flags}"
         )
