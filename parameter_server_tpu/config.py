@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import dataclasses
 import enum
-from typing import Optional, Tuple
+from typing import Optional, Tuple, Union
 
 
 class ConsistencyMode(str, enum.Enum):
@@ -191,6 +191,63 @@ class ServeConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class WireCompressionConfig:
+    """Lossy wire codec for the DCN value plane (ISSUE 14).
+
+    Selected per table (``TableConfig.compression``) and composed under
+    ``CoalescingVan`` via :class:`~parameter_server_tpu.core.filters.
+    QuantizingFilter` — one pass over the bundled value plane, PUSH
+    requests only (PULL replies stay bit-exact so the serving plane's
+    bitwise guarantees hold).
+
+    ``error_feedback`` keeps a per-(sender, table, key) residual
+    accumulator on the sender: the quantization error of each push is
+    re-injected into the NEXT push for the same keys instead of lost —
+    the EQuARX result (PAPERS.md) that makes lossy compression converge
+    like the uncompressed run.  Residuals are dropped on ``adopt_routing``
+    (new routing epoch), on a peer incarnation advance, and on a same-id
+    restart, so a rebalanced or recovered fleet never replays stale error.
+
+    ``per_row`` replaces ``FixingFloatFilter``'s old dim-based guess:
+    ``True``/``False`` force per-row/per-tensor scales; ``"auto"`` keeps
+    the measured heuristic (per-row only when the last dim is >= 16, since
+    each row scale costs 4 header-borne bytes and would rival the int8
+    payload of a dim-1 LR table).
+    """
+
+    #: wire codec: "none" (bit-exact), "int8", or "fp8".
+    codec: str = "none"
+    #: fp8 bit layout: "e4m3" (more mantissa) or "e5m2" (more range).
+    fp8_format: str = "e4m3"
+    #: "nearest" or "stochastic" (seeded from ``seed`` — deterministic).
+    rounding: str = "nearest"
+    #: carry quantization error forward per (sender, table, key).
+    error_feedback: bool = True
+    #: per-row scales: True | False | "auto" (the old dim heuristic).
+    per_row: Union[bool, str] = "auto"
+    #: stochastic-rounding rng seed (repo-wide seeded-replay contract).
+    seed: int = 0
+
+    def __post_init__(self) -> None:
+        if self.codec not in ("none", "int8", "fp8"):
+            raise ValueError(
+                f"codec must be none|int8|fp8, got {self.codec!r}"
+            )
+        if self.fp8_format not in ("e4m3", "e5m2"):
+            raise ValueError(
+                f"fp8_format must be e4m3|e5m2, got {self.fp8_format!r}"
+            )
+        if self.rounding not in ("nearest", "stochastic"):
+            raise ValueError(
+                f"rounding must be nearest|stochastic, got {self.rounding!r}"
+            )
+        if not (self.per_row in (True, False) or self.per_row == "auto"):
+            raise ValueError(
+                f'per_row must be True, False, or "auto", got {self.per_row!r}'
+            )
+
+
+@dataclasses.dataclass(frozen=True)
 class TableConfig:
     """A KV table: the unit the reference range-partitions across servers.
 
@@ -223,3 +280,5 @@ class TableConfig:
     #: kernel groups); under XLA it traces the op-for-op identical graph as
     #: the legacy three-pass body, so flipping it is bitwise-neutral there.
     fused_apply: bool = True
+    #: lossy wire codec for this table's PUSH plane; None = bit-exact wire.
+    compression: Optional[WireCompressionConfig] = None

@@ -187,10 +187,34 @@ class CoalescingVan(VanWrapper):
         *,
         max_msgs: int = 64,
         max_delay: float = 0.002,
+        codec=None,
     ) -> None:
         super().__init__(inner)
         self.max_msgs = int(max_msgs)
         self.max_delay = float(max_delay)
+        #: optional lossy wire codec (``filters.QuantizingFilter``) applied
+        #: ONCE per outgoing frame at flush time — a single pass over the
+        #: bundled value plane — and inverted in ``unbundle`` before
+        #: dispatch.  CONTROL passthrough traffic skips it.  Duck-typed
+        #: (needs encode/decode/on_send_failed) to avoid a filters import.
+        self.codec = codec
+        if codec is not None:
+            # Residual lifecycle: a peer incarnation advance (crash/restart,
+            # same-id restart) means carried error must not replay into the
+            # recovered server.  ReliableVan exposes the hook; find it by
+            # walking inner (the stack order is fixed but spelled by config).
+            reset = getattr(codec, "reset_residuals", None)
+            v = inner
+            while v is not None and reset is not None:
+                hooks = v.__dict__.get("on_incarnation_advance")
+                if isinstance(hooks, list):
+                    hooks.append(
+                        lambda node_id, inc, _r=reset: _r(
+                            reason=f"incarnation_advance:{node_id}:{inc}"
+                        )
+                    )
+                    break
+                v = getattr(v, "inner", None)
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._buffers: dict[tuple[str, str], _LinkBuffer] = {}
@@ -283,7 +307,13 @@ class CoalescingVan(VanWrapper):
                 self._frames += 1
                 self._msgs += len(subs)
             frame = subs[0] if len(subs) == 1 else _pack(subs)
-            ok = self.inner.send(frame)
+            if self.codec is not None:
+                encoded = self.codec.encode(frame)
+            else:
+                encoded = frame
+            ok = self.inner.send(encoded)
+            if not ok and self.codec is not None:
+                self.codec.on_send_failed(frame, encoded)
         if len(subs) > 1:
             flightrec.record(
                 "bundle.flush", node=link[0], recver=link[1],
@@ -359,6 +389,8 @@ class CoalescingVan(VanWrapper):
             # flushed the moment handling ends — a sync round trip never
             # waits out ``max_delay``.
             with self.window():
+                if self.codec is not None:
+                    msg = self.codec.decode(msg)
                 if msg.task.customer != BUNDLE_CUSTOMER:
                     handler(msg)
                     return
@@ -394,7 +426,7 @@ class CoalescingVan(VanWrapper):
 
     def counters(self) -> dict:
         with self._lock:
-            return {
+            out = {
                 "coalesce_frames": self._frames,
                 "coalesce_msgs": self._msgs,
                 "coalesce_passthrough": self._passthrough,
@@ -402,3 +434,7 @@ class CoalescingVan(VanWrapper):
                 "coalesce_flush_timer": self._flush_timer,
                 "coalesce_undeliverable": self._undeliverable,
             }
+        codec_counters = getattr(self.codec, "counters", None)
+        if codec_counters is not None:
+            out.update(codec_counters())
+        return out

@@ -22,8 +22,21 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from parameter_server_tpu.core.messages import Message
-from parameter_server_tpu.ops.quantize import dequantize_int8, quantize_int8
+from parameter_server_tpu.config import WireCompressionConfig
+from parameter_server_tpu.core import flightrec
+from parameter_server_tpu.core.frame import COMPRESSED_KEY
+from parameter_server_tpu.core.messages import Message, TaskKind
+from parameter_server_tpu.ops.quantize import (
+    dequantize_fp8,
+    dequantize_int8,
+    quantize_fp8,
+    quantize_int8,
+)
+
+# Bundle frame constants, mirrored from core/coalesce.py (importing it here
+# would cycle through core/van.py); test_compress asserts they stay equal.
+_BUNDLE_CUSTOMER = "__bundle__"
+_BUNDLE_KEY = "__subs__"
 
 
 def _msg_copy(msg: Message) -> Message:
@@ -241,12 +254,38 @@ class CompressingFilter(Filter):
         return out
 
 
+def _resolve_per_row(per_row, v: np.ndarray) -> bool:
+    """Resolve a ``per_row`` config (True | False | "auto") for one array.
+
+    "auto" keeps the measured heuristic: per-row scales only pay off for
+    wide rows — each costs 4 B of (uncompressed, header-borne) f32, so on
+    narrow arrays (the dim=1 LR tables) they would rival the int8 payload
+    itself and INFLATE wire bytes.
+    """
+    if per_row == "auto":
+        return v.ndim >= 2 and v.shape[-1] >= 16
+    return bool(per_row)
+
+
 class FixingFloatFilter(Filter):
-    """float32 -> int8 + scale per value array (fixing_float analogue)."""
+    """float32 -> int8 + scale per value array (fixing_float analogue).
+
+    ``config`` (a :class:`WireCompressionConfig`) makes the scale layout
+    and rounding explicit; legacy kwargs remain for the spec-string path.
+    """
 
     name = "fixing_float"
 
-    def __init__(self, stochastic: bool = False, seed: int = 0) -> None:
+    def __init__(
+        self,
+        stochastic: bool = False,
+        seed: int = 0,
+        config: Optional[WireCompressionConfig] = None,
+    ) -> None:
+        if config is not None:
+            stochastic = stochastic or config.rounding == "stochastic"
+            seed = config.seed if seed == 0 else seed
+        self.per_row = config.per_row if config is not None else "auto"
         self.stochastic = stochastic
         self._rng = np.random.default_rng(seed)
         self._lock = threading.Lock()  # the RNG is not thread-safe
@@ -259,12 +298,7 @@ class FixingFloatFilter(Filter):
         for v in msg.values:
             v = np.asarray(v)
             if v.dtype == np.float32 and v.size:
-                # Per-row scales only pay off for wide rows: each costs 4 B
-                # of (uncompressed, header-borne) f32, so on narrow arrays —
-                # the dim=1 LR tables — they would rival the int8 payload
-                # itself and INFLATE wire bytes.  Narrow arrays get one
-                # per-tensor scale.
-                per_row = v.ndim >= 2 and v.shape[-1] >= 16
+                per_row = _resolve_per_row(self.per_row, v)
                 if self.stochastic:  # only the RNG path needs the lock
                     with self._lock:
                         q, s = quantize_int8(
@@ -302,6 +336,431 @@ class FixingFloatFilter(Filter):
             if k not in ("q8_scales", "q8_mask")
         }
         return out
+
+
+#: residual stores flip from sorted-sparse to dense slot-indexed arrays once
+#: they hold this many keys (and the dense array stays under the byte cap):
+#: past that point the per-push sorted merge costs more than the scatter.
+_DENSE_PROMOTE_KEYS = 16384
+_DENSE_MAX_BYTES = 64 << 20
+
+
+class QuantizingFilter(Filter):
+    """Error-feedback lossy codec for the PUSH value plane (ISSUE 14).
+
+    Composed UNDER :class:`~parameter_server_tpu.core.coalesce.CoalescingVan`
+    (its ``codec=`` slot), so it runs ONCE per outgoing frame over the
+    already-bundled value plane — member arrays are planes of the one
+    bundle frame, quantized in a single pass with no re-encode.  Only PUSH
+    *requests* are touched; PULL replies (the serving plane) stay bit-exact.
+
+    Per ``(sender, table)`` the filter keeps a sorted-key residual store:
+    the quantization error of each push is re-injected into the NEXT push
+    for the same keys (gather by ``searchsorted``, commit by union merge)
+    instead of lost — the EQuARX error-feedback scheme that makes lossy
+    compression converge like the uncompressed run.  Residuals are keyed by
+    sender because loopback test clusters share ONE van (and thus one codec
+    instance) across every node.  EF is skipped (plain quantize) for planes
+    whose key array is not strictly increasing: duplicate keys would make
+    the residual scatter ambiguous.
+
+    Lifecycle: :meth:`reset_residuals` drops stores on ``adopt_routing``
+    (routing-epoch advance — key ranges moved), on a peer incarnation
+    advance or same-id restart (``ReliableVan.on_incarnation_advance``),
+    and on a failed wire write (``on_send_failed`` — the push never arrived
+    and the app-level retry must not double-count carried error).
+
+    Wire marker: payload ``COMPRESSED_KEY`` -> ``{"v": [entry|None per
+    plane], "saved": bytes}`` where entry is ``(codec, fmt, dtype, shape,
+    scale)``; the frame layer sets ``FLAG_COMPRESSED`` on it and MeteredVan
+    uses ``saved`` to account raw vs wire bytes per link.  Decode is one
+    table-gather/multiply per plane, straight off a read-only frombuffer
+    view — no receive-side state.
+    """
+
+    name = "quantizing"
+    stateless = True  # decode is marker-driven; residual state is keyed by
+    # message content (sender/table), not by link identity
+
+    def __init__(
+        self,
+        default: Optional[WireCompressionConfig] = None,
+        per_table: Optional[Dict[str, WireCompressionConfig]] = None,
+    ) -> None:
+        self.default = default if default is not None else WireCompressionConfig()
+        self.per_table = dict(per_table or {})
+        self._lock = threading.Lock()
+        self._rng = np.random.default_rng(self.default.seed)
+        #: (sender, table) -> {"keys": int64[n] sorted, "vals": f32[n, ...],
+        #: "sq": float running sum of squared residuals}
+        self._residuals: Dict[Tuple[str, str], dict] = {}
+        self.raw_bytes = 0
+        self.wire_bytes = 0
+        self.resets = 0
+
+    # -- config -------------------------------------------------------------
+    def _cfg(self, table: Optional[str]) -> WireCompressionConfig:
+        cfg = self.per_table.get(table) if table is not None else None
+        return cfg if cfg is not None else self.default
+
+    # -- quantize core (callers hold self._lock: the RNG is not thread-safe)
+    def _quantize_plane(self, cfg: WireCompressionConfig, g: np.ndarray):
+        per_row = _resolve_per_row(cfg.per_row, g)
+        stoch = cfg.rounding == "stochastic"
+        rng = self._rng if stoch else None
+        if cfg.codec == "int8":
+            q, s = quantize_int8(g, per_row=per_row, stochastic=stoch, rng=rng)
+            dq = dequantize_int8(q, s)
+        else:
+            q, s = quantize_fp8(
+                g, fmt=cfg.fp8_format, per_row=per_row, stochastic=stoch,
+                rng=rng,
+            )
+            dq = dequantize_fp8(q, s, fmt=cfg.fp8_format)
+        return q, s, dq
+
+    def _encode_value(
+        self,
+        cfg: WireCompressionConfig,
+        sender: str,
+        table: Optional[str],
+        keys: Optional[np.ndarray],
+        v: np.ndarray,
+    ):
+        """Quantize one plane, with error feedback when keys align with rows.
+
+        Eligible key planes are the worker push layout: sorted unique slot
+        ids, optionally padded to a power-of-two bucket with a constant
+        trash-row tail (``utils.keys.localize_to_slots``).  EF covers the
+        strictly-increasing real prefix; pad rows are zeros and quantize
+        exactly, so skipping them loses nothing.
+        """
+        k = None
+        n_real = 0
+        if cfg.error_feedback and table is not None and keys is not None:
+            ka = np.asarray(keys)
+            if ka.ndim == 1 and v.ndim >= 1 and ka.shape[0] == v.shape[0]:
+                if ka.size < 2 or bool(np.all(ka[1:] > ka[:-1])):
+                    n_real = ka.size
+                else:
+                    # padded bucket: real slots strictly increase, then a
+                    # constant run of the localizer's trash row
+                    p = int(np.searchsorted(ka, ka[-1], side="left"))
+                    if (
+                        p >= 1
+                        and bool(np.all(ka[p:] == ka[-1]))
+                        and bool(np.all(ka[1:p] > ka[: p - 1]))
+                    ):
+                        n_real = p
+                if n_real:
+                    k = ka[:n_real].astype(np.int64, copy=False).reshape(-1)
+        if k is None:
+            q, s, _dq = self._quantize_plane(cfg, v)
+            return q, s
+        st = self._residuals.get((sender, table))
+        if st is not None and st["vals"].shape[1:] != v.shape[1:]:
+            st = None  # table reshaped underneath us: the store is stale
+        if st is not None and st.get("dense"):
+            return self._ef_dense(cfg, st, k, n_real, v)
+        pos = hit = None
+        r = None
+        if st is not None and len(st["keys"]):
+            pos = np.minimum(
+                np.searchsorted(st["keys"], k), len(st["keys"]) - 1
+            )
+            hit = st["keys"][pos] == k
+            if hit.any():
+                r = np.zeros_like(v, dtype=np.float32)
+                r[:n_real][hit] = st["vals"][pos[hit]]
+        g = v if r is None else v + r
+        q, s, dq = self._quantize_plane(cfg, g)
+        err = np.ascontiguousarray((g - dq)[:n_real], dtype=np.float32)
+        if st is None:
+            st = {"keys": k.copy(), "vals": err, "sq": float((err * err).sum())}
+            self._residuals[(sender, table)] = st
+            self._maybe_promote_dense(st)
+            return q, s
+        # Commit without re-sorting: both key arrays are sorted, so hits
+        # update in place (reusing the gather's searchsorted) and misses
+        # splice in with one O(n) np.insert — the union1d rebuild this
+        # replaces cost ~2.5 ms/step at the bench's 8k-key pushes.
+        sq = st["sq"] + float((err * err).sum())
+        if hit is not None and hit.any():
+            old = st["vals"][pos[hit]]
+            sq -= float((old * old).sum())
+            st["vals"][pos[hit]] = err[hit]
+            new = ~hit
+        else:
+            new = np.ones(len(k), dtype=bool)
+        if new.any():
+            nk = k[new]
+            idx = np.searchsorted(st["keys"], nk)
+            st["keys"] = np.insert(st["keys"], idx, nk)
+            st["vals"] = np.insert(st["vals"], idx, err[new], axis=0)
+        st["sq"] = max(sq, 0.0)
+        self._maybe_promote_dense(st)
+        return q, s
+
+    def _maybe_promote_dense(self, st: dict) -> None:
+        """Flip a hot sparse store to a slot-indexed dense array.
+
+        Slot ids are bounded by the sender's localizer capacity, so once a
+        store holds enough keys the O(n) sorted-merge per push costs more
+        than a dense table it could scatter into directly.  Promotion is
+        gated on the projected array size so fat-dim tables stay sparse.
+        """
+        if len(st["keys"]) < _DENSE_PROMOTE_KEYS:
+            return
+        tail = st["vals"].shape[1:]
+        # slot ids come from power-of-two localizer buckets: round capacity
+        # up so later pushes with higher slots rarely force a regrow
+        cap = 1 << int(st["keys"][-1]).bit_length()
+        if cap * int(np.prod(tail, dtype=np.int64)) * 4 > _DENSE_MAX_BYTES:
+            return
+        dense = np.zeros((cap,) + tail, np.float32)
+        dense[st["keys"]] = st["vals"]
+        st["vals"] = dense
+        st["dense"] = True
+        del st["keys"]
+
+    def _ef_dense(self, cfg, st: dict, k, n_real: int, v: np.ndarray):
+        """Error-feedback round trip against a dense slot-indexed store."""
+        dense = st["vals"]
+        top = int(k[-1])
+        if top >= dense.shape[0]:
+            cap = 1 << top.bit_length()  # pow2 growth: amortize regrows
+            pad = np.zeros(
+                (cap - dense.shape[0],) + dense.shape[1:], np.float32
+            )
+            dense = np.concatenate([dense, pad])
+            st["vals"] = dense
+        old = dense[k]
+        g = v.astype(np.float32, copy=True)
+        g[:n_real] += old
+        q, s, dq = self._quantize_plane(cfg, g)
+        err = np.ascontiguousarray((g - dq)[:n_real], dtype=np.float32)
+        dense[k] = err
+        st["sq"] = max(
+            st["sq"] + float((err * err).sum()) - float((old * old).sum()), 0.0
+        )
+        return q, s
+
+    # -- codec --------------------------------------------------------------
+    def encode(self, msg: Message) -> Message:
+        if not msg.is_request:
+            return msg
+        payload = msg.task.payload
+        if (
+            msg.task.customer == _BUNDLE_CUSTOMER
+            and payload.get(_BUNDLE_KEY) is not None
+        ):
+            return self._encode_bundle(msg)
+        if msg.task.kind is not TaskKind.PUSH:
+            return msg
+        table = payload.get("table")
+        cfg = self._cfg(table)
+        if cfg.codec == "none" or not msg.values:
+            return msg
+        entries: List[Optional[tuple]] = [None] * len(msg.values)
+        new_vals = list(msg.values)
+        raw = wire = 0
+        with self._lock:
+            for i, v in enumerate(msg.values):
+                v = np.asarray(v)
+                if v.dtype != np.float32 or not v.size:
+                    continue
+                q, s = self._encode_value(cfg, msg.sender, table, msg.keys, v)
+                new_vals[i] = q
+                entries[i] = (
+                    cfg.codec, cfg.fp8_format, v.dtype.str, tuple(v.shape), s
+                )
+                raw += v.nbytes
+                wire += q.nbytes + np.asarray(s).nbytes
+        return self._finish_encode(msg, entries, new_vals, raw, wire)
+
+    def _encode_bundle(self, msg: Message) -> Message:
+        """One pass over a CoalescingVan bundle's concatenated value plane."""
+        index = msg.task.payload[_BUNDLE_KEY]
+        key_bytes = (
+            np.ascontiguousarray(msg.keys).reshape(-1).view(np.uint8)
+            if msg.keys is not None
+            else np.empty(0, dtype=np.uint8)
+        )
+        entries: List[Optional[tuple]] = [None] * len(msg.values)
+        new_vals = list(msg.values)
+        raw = wire = 0
+        k_off = v_off = 0
+        with self._lock:
+            for customer, kind, _t, _w, payload, is_request, key_meta, n_v in index:
+                chunk = None
+                if key_meta is not None:
+                    dt, shape, nbytes = key_meta
+                    chunk = key_bytes[k_off : k_off + nbytes]
+                    k_off += nbytes
+                if kind == TaskKind.PUSH.value and is_request:
+                    table = payload.get("table")
+                    cfg = self._cfg(table)
+                    if cfg.codec != "none":
+                        keys = (
+                            chunk.copy().view(np.dtype(dt)).reshape(shape)
+                            if chunk is not None
+                            else None
+                        )
+                        for j in range(v_off, v_off + n_v):
+                            v = np.asarray(msg.values[j])
+                            if v.dtype != np.float32 or not v.size:
+                                continue
+                            q, s = self._encode_value(
+                                cfg, msg.sender, table, keys, v
+                            )
+                            new_vals[j] = q
+                            entries[j] = (
+                                cfg.codec, cfg.fp8_format, v.dtype.str,
+                                tuple(v.shape), s,
+                            )
+                            raw += v.nbytes
+                            wire += q.nbytes + np.asarray(s).nbytes
+                v_off += n_v
+        return self._finish_encode(msg, entries, new_vals, raw, wire)
+
+    def _finish_encode(self, msg, entries, new_vals, raw, wire) -> Message:
+        if raw == 0:  # nothing quantizable on this frame
+            return msg
+        out = _msg_copy(msg)
+        out.values = new_vals
+        out.task.payload[COMPRESSED_KEY] = {
+            "v": entries,
+            "saved": int(raw - wire),
+        }
+        with self._lock:
+            self.raw_bytes += raw
+            self.wire_bytes += wire
+        flightrec.record(
+            "compress.encode",
+            node=msg.sender,
+            recver=msg.recver,
+            planes=sum(e is not None for e in entries),
+            bytes_in=raw,
+            bytes_out=wire,
+        )
+        return out
+
+    def decode(self, msg: Message) -> Message:
+        wc = msg.task.payload.get(COMPRESSED_KEY)
+        if wc is None:
+            return msg
+        out = _msg_copy(msg)
+        vals = list(msg.values)
+        n = 0
+        for i, ent in enumerate(wc["v"]):
+            if ent is None:
+                continue
+            codec, fmt, dt, shape, scale = ent
+            q = np.asarray(vals[i])
+            if codec == "int8":
+                x = dequantize_int8(q, scale)
+            else:
+                x = dequantize_fp8(q, scale, fmt=fmt)
+            vals[i] = np.ascontiguousarray(
+                x.astype(np.dtype(dt), copy=False)
+            ).reshape(tuple(shape))
+            n += 1
+        out.values = vals
+        out.task.payload = {
+            k: v for k, v in msg.task.payload.items() if k != COMPRESSED_KEY
+        }
+        flightrec.record(
+            "compress.decode", node=msg.recver, sender=msg.sender, planes=n
+        )
+        return out
+
+    def on_send_failed(
+        self, msg: Message, encoded: Optional[Message] = None
+    ) -> None:
+        # The frame never hit the wire: any residual committed during its
+        # encode describes error the receiver never absorbed, and the
+        # app-level retry will re-push the full gradient.  Conservatively
+        # drop this sender's stores rather than replay carried error twice.
+        marker = (encoded or msg).task.payload.get(COMPRESSED_KEY)
+        if marker is not None:
+            self.reset_residuals(sender=msg.sender, reason="send_failed")
+
+    # -- lifecycle / metrics ------------------------------------------------
+    def reset_residuals(
+        self,
+        *,
+        sender: Optional[str] = None,
+        table: Optional[str] = None,
+        reason: str = "manual",
+    ) -> int:
+        """Drop residual stores matching ``sender``/``table`` (None = all)."""
+        with self._lock:
+            doomed = [
+                key
+                for key in self._residuals
+                if (sender is None or key[0] == sender)
+                and (table is None or key[1] == table)
+            ]
+            for key in doomed:
+                del self._residuals[key]
+            self.resets += 1
+        flightrec.record(
+            "compress.residual_reset",
+            node=sender if sender is not None else "*",
+            table=table if table is not None else "*",
+            reason=reason,
+            dropped=len(doomed),
+        )
+        return len(doomed)
+
+    def residual_norm(self) -> float:
+        """L2 norm of every outstanding residual (the EF debt gauge)."""
+        with self._lock:
+            sq = sum(st["sq"] for st in self._residuals.values())
+        return float(np.sqrt(max(sq, 0.0)))
+
+    def counters(self) -> dict:
+        with self._lock:
+            raw, wire = self.raw_bytes, self.wire_bytes
+            resets = self.resets
+            sq = sum(st["sq"] for st in self._residuals.values())
+        out = {
+            "compress_raw_bytes": raw,
+            "compress_wire_bytes": wire,
+            "compress_resets": resets,
+            "compress_residual_norm": round(float(np.sqrt(max(sq, 0.0))), 6),
+        }
+        if raw:
+            out["compress_ratio_pct"] = round(100.0 * wire / raw, 2)
+        return out
+
+
+def find_quantizers(van) -> List[QuantizingFilter]:
+    """Every QuantizingFilter reachable from a van stack, outermost-first.
+
+    Walks ``.inner`` links, collecting CoalescingVan ``codec`` slots and any
+    QuantizingFilter sitting inside a ``filter_chain`` — deduplicated by
+    identity (VanWrapper ``__getattr__`` delegation would otherwise report
+    the same codec at every level).  Workers use this from ``adopt_routing``
+    to reset residuals without knowing the stack shape.
+    """
+    out: List[QuantizingFilter] = []
+    seen: set = set()
+    seen_vans: set = set()
+    v = van
+    while v is not None and id(v) not in seen_vans:
+        seen_vans.add(id(v))
+        codec = getattr(v, "codec", None)
+        if isinstance(codec, QuantizingFilter) and id(codec) not in seen:
+            seen.add(id(codec))
+            out.append(codec)
+        chain = getattr(v, "filter_chain", None)
+        for f in getattr(chain, "filters", ()) or ():
+            if isinstance(f, QuantizingFilter) and id(f) not in seen:
+                seen.add(id(f))
+                out.append(f)
+        v = getattr(v, "inner", None)
+    return out
 
 
 class AddNoiseFilter(Filter):
@@ -411,12 +870,42 @@ class FilterChain:
         return bi, bo
 
 
+def quantizer_from_tables(
+    tables, default: Optional[WireCompressionConfig] = None
+) -> Optional[QuantizingFilter]:
+    """Build the CoalescingVan codec from per-table configs, or None.
+
+    ``tables``: iterable of :class:`~parameter_server_tpu.config.TableConfig`
+    or a ``{name: TableConfig}`` dict (the shape servers/workers carry);
+    their ``compression`` fields select per-table codecs; ``default``
+    applies to tables without one.  Returns None when nothing asks for
+    compression, so callers can pass the result straight to
+    ``CoalescingVan(..., codec=...)``.
+    """
+    if isinstance(tables, dict):
+        tables = tables.values()
+    per_table = {
+        t.name: t.compression
+        for t in tables
+        if getattr(t, "compression", None) is not None
+    }
+    if not per_table and (default is None or default.codec == "none"):
+        return None
+    return QuantizingFilter(default=default, per_table=per_table)
+
+
 #: filter factories by spec token; order in the spec string = encode order.
 _FILTER_FACTORIES = {
     "key_caching": KeyCachingFilter,
     "int8": FixingFloatFilter,
     "zlib": CompressingFilter,
     "noise": AddNoiseFilter,
+    # the error-feedback int8 codec as a chain member (launcher opt-in);
+    # the preferred composition is CoalescingVan(codec=...), where it runs
+    # once per bundle, but in-chain it still handles bundle frames whole.
+    "quantize": lambda: QuantizingFilter(
+        WireCompressionConfig(codec="int8", error_feedback=True)
+    ),
 }
 
 #: The launcher default for DCN vans (VERDICT r3 #7): codecs on by default —

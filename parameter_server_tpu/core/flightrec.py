@@ -101,6 +101,13 @@ EVENTS = frozenset({
     "cache.miss",
     "cache.invalidate",
     "serve.shed",
+    # quantized wire plane (core/filters.py QuantizingFilter): a frame's
+    # value planes lossily encoded at flush / dequantized before dispatch /
+    # error-feedback residual stores dropped (reason field says which
+    # lifecycle edge: adopt_routing, incarnation_advance, send_failed)
+    "compress.encode",
+    "compress.decode",
+    "compress.residual_reset",
 })
 
 #: env var: when set, recv-thread exceptions auto-dump a bundle here.

@@ -108,6 +108,17 @@ FLAG_SEQ = 1 << 2
 FLAG_INC = 1 << 3
 FLAG_EPOCH = 1 << 4
 FLAG_E2E_CRC = 1 << 5
+#: one or more value planes are lossily quantized (ISSUE 14): the payload
+#: carries a ``COMPRESSED_KEY`` marker describing per-plane codec/scale,
+#: and receivers dequantize off the frombuffer plane view before H2D.
+#: Purely informational at the frame layer (decode is marker-driven);
+#: exists so wire captures / foreign receivers can tell a compressed
+#: plane from a raw one without parsing the meta section.
+FLAG_COMPRESSED = 1 << 6
+
+#: payload key the quantizing codec stamps (``core/filters.py``); frames
+#: whose payload carries it get ``FLAG_COMPRESSED`` set in the header.
+COMPRESSED_KEY = "wc_meta"
 
 _KINDS = (TaskKind.PUSH, TaskKind.PULL, TaskKind.CONTROL)
 _KIND_INDEX = {k: i for i, k in enumerate(_KINDS)}
@@ -641,6 +652,10 @@ def encode(msg: Message) -> bytes:
         flags |= FLAG_EPOCH
     if e2e is not None:
         flags |= FLAG_E2E_CRC
+    if isinstance(payload, dict) and COMPRESSED_KEY in payload:
+        # lossy-quantized plane(s) aboard: decode stays marker-driven, the
+        # header bit is for captures/foreign receivers (and MIGRATION.md)
+        flags |= FLAG_COMPRESSED
 
     meta = bytearray()
     for name in (msg.task.customer, msg.sender, msg.recver):

@@ -67,12 +67,17 @@ def payload_nbytes(msg: Message) -> int:
 class _LinkStats:
     """Counters + histograms for one directed link."""
 
-    __slots__ = ("msgs", "bytes", "frame_bytes", "overhead_bytes",
-                 "send", "deliver")
+    __slots__ = ("msgs", "bytes", "raw_bytes", "frame_bytes",
+                 "overhead_bytes", "send", "deliver")
 
     def __init__(self) -> None:
         self.msgs = 0
         self.bytes = 0
+        #: pre-compression payload bytes: ``bytes`` plus whatever the lossy
+        #: wire codec saved (its payload marker's ``saved`` total).  Equal
+        #: to ``bytes`` on uncompressed links; the per-link compression
+        #: ratio is ``bytes / raw_bytes`` with no filter instrumentation.
+        self.raw_bytes = 0
         #: exact flat-frame wire size (``core/frame.py``): payload planes
         #: PLUS the 52-byte fixed header and the encoded meta section —
         #: per-message framing tax, measured rather than modeled.
@@ -125,6 +130,12 @@ class MeteredVan(VanWrapper):
     # -- send path -----------------------------------------------------------
     def send(self, msg: Message) -> bool:
         nbytes = payload_nbytes(msg)
+        saved = 0
+        p = msg.task.payload
+        if isinstance(p, dict):
+            wc = p.get(frame.COMPRESSED_KEY)
+            if isinstance(wc, dict):
+                saved = int(wc.get("saved", 0))
         out = msg
         if self._stamp:
             # direct constructors, not dataclasses.replace: replace() pays
@@ -157,6 +168,7 @@ class MeteredVan(VanWrapper):
             st = self._link(msg.sender, msg.recver)
             st.msgs += 1
             st.bytes += nbytes
+            st.raw_bytes += nbytes + saved
             st.frame_bytes += fbytes
             st.overhead_bytes += obytes
             st.send.record(dt)
@@ -208,6 +220,9 @@ class MeteredVan(VanWrapper):
             return {
                 "wire_msgs": sum(st.msgs for st in self._links.values()),
                 "wire_bytes": sum(st.bytes for st in self._links.values()),
+                "wire_raw_bytes": sum(
+                    st.raw_bytes for st in self._links.values()
+                ),
                 "wire_frame_bytes": sum(
                     st.frame_bytes for st in self._links.values()
                 ),
@@ -225,6 +240,7 @@ class MeteredVan(VanWrapper):
                 f"{s}->{r}": {
                     "msgs": st.msgs,
                     "bytes": st.bytes,
+                    "raw_bytes": st.raw_bytes,
                     "frame_bytes": st.frame_bytes,
                     "overhead_bytes": st.overhead_bytes,
                     "send": st.send.to_dict(),
@@ -246,6 +262,7 @@ class MeteredVan(VanWrapper):
                 f"{s}->{r}": {
                     "msgs": st.msgs,
                     "bytes": st.bytes,
+                    "raw_bytes": st.raw_bytes,
                     "frame_bytes": st.frame_bytes,
                     "overhead_bytes": st.overhead_bytes,
                     "send": st.send.to_dict(),

@@ -216,6 +216,12 @@ class ReliableVan(VanWrapper):
         self.rejected_stale = 0
         #: frames dropped by the CRC32 integrity check (bit-flips in flight).
         self.rejected_corrupt = 0
+        #: callbacks ``(node_id, incarnation)`` fired (outside the lock)
+        #: whenever a peer's incarnation ADVANCES — both the receive-side
+        #: learn and the explicit :meth:`set_incarnation` path.  Consumers:
+        #: the quantizing codec drops error-feedback residuals so carried
+        #: quantization error never replays into a restarted peer.
+        self.on_incarnation_advance: list = []
         self._thread = threading.Thread(
             target=self._retransmit_loop, name="resender-retx", daemon=True
         )
@@ -267,6 +273,7 @@ class ReliableVan(VanWrapper):
                 # peer restarted: its new process counts seqs from 0 again —
                 # reset every window keyed to the old incarnation's seq space
                 self._reset_sender_windows(msg.sender)
+                self._fire_incarnation_advance(msg.sender, inc)
             # ACK before processing: the sender's clock starts at *its* send
             self._send_ack(msg, seq, inc)
             with self._lock:
@@ -442,7 +449,15 @@ class ReliableVan(VanWrapper):
                 del self._next_seq[link]
             for key in [k for k in self._pending if k[0][0] == node_id]:
                 del self._pending[key]
+        self._fire_incarnation_advance(node_id, incarnation)
         return True
+
+    def _fire_incarnation_advance(self, node_id: str, incarnation: int) -> None:
+        for hook in list(self.on_incarnation_advance):
+            try:
+                hook(node_id, incarnation)
+            except Exception:  # noqa: BLE001 — observer hooks must not
+                _log.exception("resender: incarnation-advance hook failed")
 
     def restart_node(self, node_id: str) -> int:
         """Local-authority restart: bump ``node_id``'s incarnation in place.

@@ -470,6 +470,13 @@ class TelemetryAggregator:
             row["cache_hit_pct"] = round(
                 100.0 * cum_snapshot.get("cache_hits", 0) / looked, 2
             )
+        # quantized wire plane (ISSUE 14): lifetime compressed-vs-raw ratio
+        # off the CUMULATIVE MeteredVan byte counters (same reasoning)
+        raw = cum_snapshot.get("wire_raw_bytes", 0)
+        if raw and raw != cum_snapshot.get("wire_bytes", 0):
+            row["cmpr_pct"] = round(
+                100.0 * cum_snapshot.get("wire_bytes", 0) / raw, 2
+            )
         if deliver.count:
             row["deliver_p99_ms"] = round(1e3 * deliver.percentile(0.99), 3)
             row["deliver_p50_ms"] = round(1e3 * deliver.percentile(0.50), 3)

@@ -190,6 +190,18 @@ class KVWorker(Customer):
             # anyway (owner changed) — but a range that moved AND moved back
             # across epochs could alias, so adoption drops everything.
             self.cache.invalidate_all(reason="routing-epoch")
+        if adopted:
+            # quantized wire plane: error-feedback residuals describe error
+            # owed to the OLD owners of each key range — after a migration
+            # they would replay stale error into the new owner's rows.
+            from parameter_server_tpu.core.filters import find_quantizers
+
+            van = getattr(self.post, "van", None)
+            if van is not None:
+                for codec in find_quantizers(van):
+                    codec.reset_residuals(
+                        sender=self.post.node_id, reason="adopt_routing"
+                    )
         return adopted
 
     def counters(self) -> dict:

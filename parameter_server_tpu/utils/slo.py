@@ -392,6 +392,44 @@ def serving_plane_specs(
     ]
 
 
+def compression_plane_specs(
+    *,
+    max_ratio_pct: float = 50.0,
+    max_residual_norm: float = 1e4,
+    window_s: float = 10.0,
+) -> List[SloSpec]:
+    """The ISSUE-14 quantized-wire-plane SLO pair.
+
+    Both metrics ride the telemetry counter channel (the QuantizingFilter's
+    ``counters()`` merged through ``CoalescingVan`` / ``transport_counters``),
+    so ``SloEngine.ingest_counters`` picks them up with no new plumbing:
+
+    - ``compress-ratio``: ``compress_ratio_pct`` gauge (compressed bytes as
+      a percentage of raw) — breaching the ceiling means the codec stopped
+      earning its keep (e.g. per-row scales inflating a narrow table);
+    - ``compress-residual``: ``compress_residual_norm`` gauge, the L2 norm
+      of outstanding error-feedback debt.  A norm that grows without bound
+      means carried error is diverging (keys pushed once and never again),
+      which quietly degrades convergence long before loss curves show it.
+    """
+    return [
+        SloSpec(
+            "compress-ratio",
+            "compress_ratio_pct",
+            max_ratio_pct,
+            source="gauge",
+            window_s=window_s,
+        ),
+        SloSpec(
+            "compress-residual",
+            "compress_residual_norm",
+            max_residual_norm,
+            source="gauge",
+            window_s=window_s,
+        ),
+    ]
+
+
 def _delta_hist(first: dict, last: dict) -> LatencyHistogram:
     """Histogram of the samples recorded BETWEEN two cumulative digests.
 

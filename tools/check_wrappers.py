@@ -162,6 +162,12 @@ REQUIRED_EVENTS = frozenset({
     "cache.miss",
     "cache.invalidate",
     "serve.shed",
+    # quantized wire plane (ISSUE 14): encode/decode hooks plus the
+    # error-feedback residual lifecycle — dropping any of these would
+    # silence the compression plane's observability
+    "compress.encode",
+    "compress.decode",
+    "compress.residual_reset",
 })
 
 #: ``np.<attr>`` calls that materialize a device array on the host.

@@ -67,7 +67,7 @@ _CLEAR = "\x1b[2J\x1b[H"
 _HEADER = (
     f"{'NODE':<10} {'SEQ':>5} {'AGE':>6} {'MSG/S':>8} {'KB/S':>9} "
     f"{'P99ms':>8} {'STALE p50/p99':>14} {'INF':>4} {'BKLG':>6} "
-    f"{'APLYms':>7} {'RO/S':>7} {'HIT%':>5} {'SHED/S':>7} "
+    f"{'APLYms':>7} {'RO/S':>7} {'HIT%':>5} {'CMPR%':>6} {'SHED/S':>7} "
     f"{'DRP':>4} {'MIG':>3} {'SLO':<18} FLAGS"
 )
 
@@ -201,6 +201,9 @@ def render(latest: Dict[str, dict], now: Optional[float] = None) -> List[str]:
         # ratio is lifetime-cumulative (see core/telemetry.py)
         ro_s = row.get("ro_per_s")
         hitp = row.get("cache_hit_pct")
+        # quantized wire plane: compressed bytes as % of raw (lifetime-
+        # cumulative, derived by the aggregator from MeteredVan counters)
+        cmpr = row.get("cmpr_pct")
         shed_s = row.get("shed_per_s")
         drops = (row.get("ctl") or {}).get("drops")
         healthy = row.get("healthy")
@@ -223,6 +226,7 @@ def render(latest: Dict[str, dict], now: Optional[float] = None) -> List[str]:
             f"{f'{aply:.1f}' if aply is not None else '-':>7} "
             f"{f'{ro_s:.1f}' if ro_s is not None else '-':>7} "
             f"{f'{hitp:.1f}' if hitp is not None else '-':>5} "
+            f"{f'{cmpr:.1f}' if cmpr is not None else '-':>6} "
             f"{f'{shed_s:.1f}' if shed_s is not None else '-':>7} "
             f"{int(drops) if drops is not None else '-':>4} "
             f"{mig:>3} {slo:<18} {flags}"
