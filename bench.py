@@ -2727,6 +2727,288 @@ def record_compress(record: dict, lines: list[str]) -> None:
     )
 
 
+# -- Hierarchical push: worker-group pre-reduction (ISSUE 15) --------------
+
+_HIER_BEGIN = "<!-- BENCH-HIER:BEGIN -->"
+_HIER_END = "<!-- BENCH-HIER:END -->"
+
+#: acceptance: at group size 4 the servers' inbound PUSH plane must shrink
+#: >= 3x in BOTH bytes and request count vs the direct (ungrouped) arm,
+#: while the grouped arm holds >= 97% of direct throughput with zero
+#: fallbacks on the clean path.
+_HIER_BYTES_FLOOR = 3.0
+_HIER_REQ_FLOOR = 3.0
+_HIER_THROUGHPUT_FLOOR = 0.97
+#: headline sparse-LR shape (same as --compress: batch 2048, 26
+#: slots/example, 2^22-row x dim-1 table), replicated data-parallel
+#: across 4 workers so group members share a batch's key set — the shape
+#: hierarchical reduction exists for (ICI-local replicas of one batch).
+_HIER_WORKERS = 4
+_HIER_SERVERS = 2
+_HIER_SIZES = (1, 2, 4)
+_HIER_BATCH = 2048
+_HIER_NNZ = 26
+_HIER_ROWS = 1 << 22
+_HIER_DIM = 1
+_HIER_WARMUP = 3
+_HIER_STEPS = 20
+
+
+def _hier_push_inbound(metered) -> dict:
+    """Cumulative inbound PUSH to the servers off MeteredVan's per-link
+    per-verb counters (the satellite the arm exists to exercise)."""
+    tot = {"msgs": 0, "bytes": 0}
+    for link, d in metered.links().items():
+        _, _, recver = link.partition("->")
+        if not recver.startswith("S"):
+            continue
+        vb = (d.get("verbs") or {}).get("PUSH")
+        if vb:
+            tot["msgs"] += int(vb["msgs"])
+            tot["bytes"] += int(vb["bytes"])
+    return tot
+
+
+def _hier_arm(group_size: int) -> dict:
+    """One seeded multi-worker sparse-LR arm over a loopback cluster.
+
+    ``group_size`` workers per group (1 = direct pushes, no group plane).
+    All four workers train on the SAME seeded stream (data-parallel
+    replicas), each phase barrier-locked so every group member enters
+    ``push_sync`` together — the rendezvous the reduce-then-push contract
+    requires.  Returns throughput, final loss, the servers' inbound PUSH
+    msgs/bytes over the timed steps, and the group counters.
+    """
+    import jax.numpy as jnp
+
+    from parameter_server_tpu.config import (
+        GroupConfig, OptimizerConfig, TableConfig,
+    )
+    from parameter_server_tpu.core import flightrec
+    from parameter_server_tpu.core.coalesce import CoalescingVan
+    from parameter_server_tpu.core.netmon import MeteredVan
+    from parameter_server_tpu.core.postoffice import Postoffice
+    from parameter_server_tpu.core.van import LoopbackVan
+    from parameter_server_tpu.data.synthetic import SyntheticCTR
+    from parameter_server_tpu.kv.routing import WorkerGroup
+    from parameter_server_tpu.kv.server import KVServer
+    from parameter_server_tpu.kv.worker import KVWorker
+    from parameter_server_tpu.models import linear
+
+    cfgs = {
+        "w": TableConfig(
+            name="w", rows=_HIER_ROWS, dim=_HIER_DIM,
+            optimizer=OptimizerConfig(kind="sgd", learning_rate=0.1),
+        )
+    }
+    metered = MeteredVan(LoopbackVan())
+    van = CoalescingVan(metered)
+    flightrec.configure(enabled=True, clear=True)
+    try:
+        servers = [
+            KVServer(Postoffice(f"S{s}", van), cfgs, s, _HIER_SERVERS)
+            for s in range(_HIER_SERVERS)
+        ]
+        names = [f"W{i}" for i in range(_HIER_WORKERS)]
+        workers = []
+        for i, name in enumerate(names):
+            group = group_cfg = None
+            if group_size > 1:
+                base = (i // group_size) * group_size
+                group = WorkerGroup(
+                    members=tuple(names[base:base + group_size])
+                )
+                # generous member-rendezvous deadline: the clean path must
+                # never fall back just because a CPU thread got descheduled
+                group_cfg = GroupConfig(
+                    size=group_size, fallback_timeout=30.0
+                )
+            workers.append(
+                KVWorker(
+                    Postoffice(name, van), cfgs, _HIER_SERVERS,
+                    group=group, group_cfg=group_cfg,
+                )
+            )
+        # one seeded stream, replicated to every worker (see docstring)
+        data = SyntheticCTR(
+            key_space=_HIER_ROWS, nnz=_HIER_NNZ,
+            batch_size=_HIER_BATCH, seed=5,
+        )
+        batches = [
+            data.next_batch() for _ in range(_HIER_WARMUP + _HIER_STEPS)
+        ]
+        losses: list = [[] for _ in workers]
+        errors: list = []
+        barrier = threading.Barrier(_HIER_WORKERS)
+
+        def _run(i, worker, phase_batches):
+            try:
+                for keys, labels in phase_batches:
+                    barrier.wait()
+                    w_pos = worker.pull_sync("w", keys, timeout=120)
+                    g, _gb, loss = linear.grad_rows(
+                        jnp.asarray(w_pos), jnp.asarray(labels)
+                    )
+                    worker.push_sync(
+                        "w", keys, np.asarray(g) / labels.shape[0],
+                        timeout=120,
+                    )
+                    losses[i].append(float(loss))
+            except Exception as e:  # noqa: BLE001 — surfaced to the arm
+                errors.append(e)
+                try:
+                    barrier.abort()
+                except Exception:  # noqa: BLE001
+                    pass
+
+        def _phase(phase_batches):
+            threads = [
+                threading.Thread(
+                    target=_run, args=(i, w, phase_batches), daemon=True
+                )
+                for i, w in enumerate(workers)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            if errors:
+                raise errors[0]
+
+        _phase(batches[:_HIER_WARMUP])
+        push0 = _hier_push_inbound(metered)
+        t0 = time.perf_counter()
+        _phase(batches[_HIER_WARMUP:])
+        elapsed = time.perf_counter() - t0
+        push1 = _hier_push_inbound(metered)
+        fallbacks = sum(
+            w.counters().get("group_fallbacks", 0) for w in workers
+        )
+        group_pushes = sum(s.group_pushes for s in servers)
+        group_members = sum(s.group_members for s in servers)
+        return {
+            "examples_per_s": (
+                _HIER_WORKERS * _HIER_BATCH * _HIER_STEPS / elapsed
+            ),
+            "elapsed_s": elapsed,
+            "final_loss": float(np.mean(losses[0][-5:])),
+            "push_msgs": push1["msgs"] - push0["msgs"],
+            "push_bytes": push1["bytes"] - push0["bytes"],
+            "fallbacks": fallbacks,
+            "group_pushes": group_pushes,
+            "group_members": group_members,
+        }
+    finally:
+        van.close()
+        flightrec.configure(enabled=True, clear=True)
+
+
+def run_hier() -> tuple[dict, list[str]]:
+    """The ISSUE-15 hierarchical-push scorecard: the SAME seeded
+    data-parallel sparse-LR job (4 workers, 2 servers) run at group sizes
+    1 (direct), 2, and 4 — reporting the servers' inbound PUSH bytes and
+    request count per group size, the group-size-4 reduction factors
+    against the direct arm, the throughput ratio, and loss parity."""
+    # throwaway arm: jax compile caches are process-global (same reasoning
+    # as run_compress) — whichever timed arm runs first would otherwise
+    # eat every compilation and lose by several x
+    _hier_arm(1)
+    arms = {gs: _hier_arm(gs) for gs in _HIER_SIZES}
+    base = arms[_HIER_SIZES[0]]
+    top = arms[_HIER_SIZES[-1]]
+    bytes_x = base["push_bytes"] / top["push_bytes"] if top["push_bytes"] else 0.0
+    req_x = base["push_msgs"] / top["push_msgs"] if top["push_msgs"] else 0.0
+    tput_ratio = top["examples_per_s"] / base["examples_per_s"]
+    loss_delta = abs(top["final_loss"] - base["final_loss"])
+    passed = (
+        bytes_x >= _HIER_BYTES_FLOOR
+        and req_x >= _HIER_REQ_FLOOR
+        and tput_ratio >= _HIER_THROUGHPUT_FLOOR
+        and all(a["fallbacks"] == 0 for a in arms.values())
+    )
+    lines = [
+        f"hier: group size {_HIER_SIZES[-1]} inbound PUSH "
+        f"{base['push_bytes'] / 1e3:.1f} KB -> {top['push_bytes'] / 1e3:.1f} "
+        f"KB = {bytes_x:.2f}x (floor {_HIER_BYTES_FLOOR}x); requests "
+        f"{base['push_msgs']} -> {top['push_msgs']} = {req_x:.2f}x "
+        f"(floor {_HIER_REQ_FLOOR}x)",
+        f"throughput: {base['examples_per_s']:.0f} ex/s direct vs "
+        f"{top['examples_per_s']:.0f} ex/s grouped = {tput_ratio:.3f}x "
+        f"(floor {_HIER_THROUGHPUT_FLOOR}x); fallbacks "
+        f"{[a['fallbacks'] for a in arms.values()]}",
+        f"loss parity (mean last 5): {base['final_loss']:.4f} direct vs "
+        f"{top['final_loss']:.4f} grouped (|delta| {loss_delta:.2e})",
+        f"verdict: {'PASS' if passed else 'FAIL'}",
+    ]
+    record = {
+        "metric": "hier_push_inbound_reduction",
+        "value": round(bytes_x, 2),
+        "unit": "x",
+        "vs_baseline": _HIER_BYTES_FLOOR,
+        "pass": passed,
+        "request_reduction": round(req_x, 2),
+        "request_floor": _HIER_REQ_FLOOR,
+        "throughput_ratio": round(tput_ratio, 3),
+        "throughput_floor": _HIER_THROUGHPUT_FLOOR,
+        "final_loss_direct": round(base["final_loss"], 4),
+        "final_loss_grouped": round(top["final_loss"], 4),
+        "loss_delta": float(f"{loss_delta:.2e}"),
+        "arms": {
+            str(gs): {
+                "push_kb": round(a["push_bytes"] / 1e3, 1),
+                "push_reqs": int(a["push_msgs"]),
+                "examples_per_s": round(a["examples_per_s"], 1),
+                "final_loss": round(a["final_loss"], 4),
+                "fallbacks": int(a["fallbacks"]),
+                "group_pushes": int(a["group_pushes"]),
+                "group_members": int(a["group_members"]),
+            }
+            for gs, a in arms.items()
+        },
+    }
+    return record, lines
+
+
+def record_hier(record: dict, lines: list[str]) -> None:
+    stamp = time.strftime("%Y-%m-%d %H:%M:%S UTC", time.gmtime())
+    rows = "".join(
+        f"| {gs} | {a['push_kb']} | {a['push_reqs']} | "
+        f"{a['examples_per_s']} | {a['final_loss']} |\n"
+        for gs, a in record["arms"].items()
+    )
+    body = (
+        f"\n{stamp}; loopback cluster ({_HIER_SERVERS} servers, "
+        f"{_HIER_WORKERS} data-parallel workers on one seeded stream), "
+        f"host CPU only; headline sparse-LR shape: batch {_HIER_BATCH}, "
+        f"{_HIER_NNZ} slots/example, 2^22 rows x dim {_HIER_DIM}, sgd; "
+        f"{_HIER_STEPS} timed steps per arm, barrier-locked phases.\n\n"
+        "| group size | inbound PUSH KB | inbound PUSH requests | "
+        "examples/s | final loss (last 5) |\n|---|---|---|---|---|\n"
+        f"{rows}\n"
+        f"Inbound-bytes speedup: **{record['value']}x** against a "
+        f"{_HIER_BYTES_FLOOR}x floor; request speedup: "
+        f"**{record['request_reduction']}x** against a "
+        f"{_HIER_REQ_FLOOR}x floor; throughput ratio: "
+        f"**{record['throughput_ratio']}x** against a "
+        f"{_HIER_THROUGHPUT_FLOOR}x floor — "
+        f"{'PASS' if record['pass'] else 'FAIL'}.  Group members "
+        "pre-reduce each step's PUSH value plane locally (psum over a "
+        "shared mesh when one exists, sorted-union merge otherwise) and "
+        "only the per-(table, step) elected leader touches the wire, "
+        "stamped ``__grp__`` so the server books ONE logical apply for "
+        "the whole group.  Losses track the direct arm because the summed "
+        "gradient IS what the direct pushes apply; zero fallbacks means "
+        "no step degraded to direct per-worker push.\n"
+    )
+    _splice_baseline(
+        _HIER_BEGIN,
+        _HIER_END,
+        body,
+        "## Hierarchical push: worker-group pre-reduction "
+        "(auto-recorded by bench.py --hier)",
+    )
+
+
 # -- DLRM at scale: billion-row table proof (VERDICT r4 #3) ----------------
 
 _DLRM_SUBPROC_TIMEOUT_S = 1200.0
@@ -4092,6 +4374,32 @@ def _dispatch() -> None:
         _emit(record)
         print("\n".join(lines), file=sys.stderr)
         record_compress(record, lines)
+        return
+    if "--hier" in sys.argv[1:]:
+        # host-side only: loopback training cluster on CPU jax, no TPU probe
+        from parameter_server_tpu.utils.platform import force_cpu
+
+        force_cpu()
+        _start_watchdog("hier_push_inbound_reduction", "x")
+        try:
+            record, lines = run_hier()
+        except Exception as e:  # noqa: BLE001 — the JSON line must still emit
+            _emit(
+                {
+                    "metric": "hier_push_inbound_reduction",
+                    "value": 0.0,
+                    "unit": "x",
+                    "vs_baseline": _HIER_BYTES_FLOOR,
+                    "error": f"hier failed: {type(e).__name__}: {e}"[:500],
+                }
+            )
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
+            return
+        _emit(record)
+        print("\n".join(lines), file=sys.stderr)
+        record_hier(record, lines)
         return
     if micro:
         _start_watchdog("micro_scatter_add_pallas_speedup_vs_xla", "x")

@@ -248,6 +248,75 @@ class WireCompressionConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class GroupConfig:
+    """Hierarchical push: a worker group pre-reduces before the wire (ISSUE 15).
+
+    Co-located workers (one host / one pod slice) sum their PUSH value
+    planes locally — the MLPerf TPU-pod pattern (PAPERS.md,
+    arXiv:1909.09756) of reducing over ICI before anything crosses DCN —
+    and only one elected member pushes the reduced tensor, stamped
+    (``kv/routing.py::GROUP_KEY``) so the server accounts it as ONE
+    logical apply for the whole group.  Server inbound PUSH bytes and
+    request count drop ~linearly in ``size``.
+
+    ``election`` picks the pushing leg per ``(table, step)``:
+    ``"rotate"`` (default) spreads wire load across members
+    deterministically; ``"fixed"`` pins member 0 — required when the
+    lossy wire codec's error-feedback residuals (ISSUE 14, keyed per
+    ``(sender, table)``) should keep compressing group pushes: under
+    rotation the residual owner would change every step, so group frames
+    are stamped to BYPASS the codec instead (see
+    ``core/filters.py::QuantizingFilter``).
+
+    ``fallback`` is the degradation contract when the elected leader is
+    dead or partitioned mid-step: ``"direct"`` (default) re-pushes the
+    member's own gradient straight to the servers within the same step —
+    no loss, at direct-push cost for that step; ``"none"`` raises instead
+    (lockstep test topologies that must not mask a dead leader).
+
+    ``reduce`` selects the pre-reduction path: ``"auto"`` uses an XLA
+    ``psum`` when the members' contributions share one key set and enough
+    local devices exist to map them (the shared-mesh case), else a
+    deterministic host-side sorted-union merge (the loopback/multi-process
+    topology); ``"merge"`` forces the host path; ``"psum"`` prefers the
+    device path but still merges when key sets differ.
+    """
+
+    #: members per group (1 = grouping disabled).
+    size: int = 1
+    #: leader election per (table, step): "rotate" or "fixed".
+    election: str = "rotate"
+    #: leader-death degradation: "direct" (per-worker push) or "none".
+    fallback: str = "direct"
+    #: pre-reduction path: "auto", "psum", or "merge".
+    reduce: str = "auto"
+    #: seconds a member waits on the leader (contribution ack / done
+    #: notify) before falling back; also the leader-side age at which an
+    #: incomplete rendezvous set is flushed as a partial reduction.
+    fallback_timeout: float = 0.25
+
+    def __post_init__(self) -> None:
+        if self.size < 1:
+            raise ValueError(f"size must be >= 1, got {self.size!r}")
+        if self.election not in ("rotate", "fixed"):
+            raise ValueError(
+                f"election must be rotate|fixed, got {self.election!r}"
+            )
+        if self.fallback not in ("direct", "none"):
+            raise ValueError(
+                f"fallback must be direct|none, got {self.fallback!r}"
+            )
+        if self.reduce not in ("auto", "psum", "merge"):
+            raise ValueError(
+                f"reduce must be auto|psum|merge, got {self.reduce!r}"
+            )
+        if self.fallback_timeout <= 0:
+            raise ValueError(
+                f"fallback_timeout must be > 0, got {self.fallback_timeout!r}"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
 class TableConfig:
     """A KV table: the unit the reference range-partitions across servers.
 

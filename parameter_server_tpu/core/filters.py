@@ -37,6 +37,21 @@ from parameter_server_tpu.ops.quantize import (
 # would cycle through core/van.py); test_compress asserts they stay equal.
 _BUNDLE_CUSTOMER = "__bundle__"
 _BUNDLE_KEY = "__subs__"
+# Hierarchical-push group stamp, mirrored from kv/routing.py::GROUP_KEY
+# (same cycle argument); test_group asserts they stay equal.  A PUSH whose
+# stamp says ``ef: "bypass"`` skips the quantizer entirely: under rotating
+# leader election the error-feedback residual owner would change every
+# step, so compression is DISABLED for group frames rather than replaying
+# another member's carried error (``ef: "leader"`` — fixed election — keeps
+# quantizing; the pinned leader's (sender, table) store owns the group's
+# residual).  See config.GroupConfig.
+_GROUP_KEY = "__grp__"
+
+
+def _group_bypass(payload) -> bool:
+    """True when a PUSH payload's group stamp opts out of quantization."""
+    grp = payload.get(_GROUP_KEY) if isinstance(payload, dict) else None
+    return grp is not None and grp.get("ef") == "bypass"
 
 
 def _msg_copy(msg: Message) -> Message:
@@ -557,6 +572,8 @@ class QuantizingFilter(Filter):
             return self._encode_bundle(msg)
         if msg.task.kind is not TaskKind.PUSH:
             return msg
+        if _group_bypass(payload):
+            return msg
         table = payload.get("table")
         cfg = self._cfg(table)
         if cfg.codec == "none" or not msg.values:
@@ -597,7 +614,11 @@ class QuantizingFilter(Filter):
                     dt, shape, nbytes = key_meta
                     chunk = key_bytes[k_off : k_off + nbytes]
                     k_off += nbytes
-                if kind == TaskKind.PUSH.value and is_request:
+                if (
+                    kind == TaskKind.PUSH.value
+                    and is_request
+                    and not _group_bypass(payload)
+                ):
                     table = payload.get("table")
                     cfg = self._cfg(table)
                     if cfg.codec != "none":

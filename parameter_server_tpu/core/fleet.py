@@ -241,13 +241,21 @@ class FleetMonitor:
         return h
 
     def inbound_totals(self) -> Dict[str, dict]:
-        """Cumulative inbound wire load per node: ``{node: {bytes, msgs}}``.
+        """Cumulative inbound wire load per node:
+        ``{node: {bytes, msgs, verbs}}``.
 
         Summed over the latest per-link digests of every link INTO each
         node — the load-ranking signal the PR-6 rebalancer consumes
         (``learner/elastic.py::RebalancePolicy``).  Cumulative by design:
         the policy differences successive calls to get rates, so one missed
         heartbeat cannot fake a load drop.
+
+        ``verbs`` splits the totals per request verb
+        (``{"PUSH": {"msgs", "bytes"}, ...}``, from MeteredVan's per-link
+        verb counters) so the hierarchical-push reduction (ISSUE 15) — and
+        the Zipfian rebalance bench's before/after — can report inbound
+        request COUNT, not just bytes.  Empty for digests from pre-verb
+        publishers (old snapshots merge cleanly).
         """
         with self._lock:
             links = dict(self._links)
@@ -256,9 +264,13 @@ class FleetMonitor:
             _, _, recver = link.partition("->")
             if not recver:
                 continue
-            row = out.setdefault(recver, {"bytes": 0, "msgs": 0})
+            row = out.setdefault(recver, {"bytes": 0, "msgs": 0, "verbs": {}})
             row["bytes"] += int(digest.get("bytes", 0))
             row["msgs"] += int(digest.get("msgs", 0))
+            for verb, vd in (digest.get("verbs") or {}).items():
+                vrow = row["verbs"].setdefault(verb, {"msgs": 0, "bytes": 0})
+                vrow["msgs"] += int(vd.get("msgs", 0))
+                vrow["bytes"] += int(vd.get("bytes", 0))
         return out
 
     def snapshot(self, now: Optional[float] = None) -> Dict[str, dict]:

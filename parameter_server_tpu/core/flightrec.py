@@ -108,6 +108,15 @@ EVENTS = frozenset({
     "compress.encode",
     "compress.decode",
     "compress.residual_reset",
+    # hierarchical push (kv/worker.py group path + core/coalesce.py
+    # GroupReducer): a group's value planes pre-reduced before the wire /
+    # a leader elected for (table, step) (salt > 0 marks a fence
+    # re-election) / the group degraded to direct per-worker push (reason
+    # field says why: member_timeout, leader_timeout, dead_leader,
+    # stale_set, wire_done_error)
+    "group.reduce",
+    "group.elect",
+    "group.fallback",
 })
 
 #: env var: when set, recv-thread exceptions auto-dump a bundle here.
@@ -400,4 +409,5 @@ def anomaly_kinds() -> frozenset:
         "slo.breach",
         "apply.backlog",
         "serve.shed",
+        "group.fallback",
     })

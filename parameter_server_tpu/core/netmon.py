@@ -68,11 +68,16 @@ class _LinkStats:
     """Counters + histograms for one directed link."""
 
     __slots__ = ("msgs", "bytes", "raw_bytes", "frame_bytes",
-                 "overhead_bytes", "send", "deliver")
+                 "overhead_bytes", "verbs", "send", "deliver")
 
     def __init__(self) -> None:
         self.msgs = 0
         self.bytes = 0
+        #: per-verb split of msgs/bytes (``{"PUSH": [msgs, bytes], ...}``):
+        #: the request-COUNT-by-verb signal the hierarchical-push bench
+        #: (ISSUE 15) reads to show inbound PUSH requests dropping with
+        #: group size, and ``fleet.inbound_totals`` aggregates per node.
+        self.verbs: Dict[str, list] = {}
         #: pre-compression payload bytes: ``bytes`` plus whatever the lossy
         #: wire codec saved (its payload marker's ``saved`` total).  Equal
         #: to ``bytes`` on uncompressed links; the per-link compression
@@ -164,6 +169,7 @@ class MeteredVan(VanWrapper):
         t0 = time.perf_counter()
         ok = self.inner.send(out)
         dt = time.perf_counter() - t0
+        verb = msg.task.kind.name
         with self._lock:
             st = self._link(msg.sender, msg.recver)
             st.msgs += 1
@@ -171,12 +177,17 @@ class MeteredVan(VanWrapper):
             st.raw_bytes += nbytes + saved
             st.frame_bytes += fbytes
             st.overhead_bytes += obytes
+            vb = st.verbs.get(verb)
+            if vb is None:
+                vb = st.verbs[verb] = [0, 0]
+            vb[0] += 1
+            vb[1] += nbytes
             st.send.record(dt)
             if not ok:
                 self.undeliverable += 1
         flightrec.record(
             "frame.send", node=msg.sender, recver=msg.recver,
-            verb=msg.task.kind.name, bytes=nbytes, ok=ok,
+            verb=verb, bytes=nbytes, ok=ok,
         )
         return ok
 
@@ -243,6 +254,10 @@ class MeteredVan(VanWrapper):
                     "raw_bytes": st.raw_bytes,
                     "frame_bytes": st.frame_bytes,
                     "overhead_bytes": st.overhead_bytes,
+                    "verbs": {
+                        v: {"msgs": c[0], "bytes": c[1]}
+                        for v, c in st.verbs.items()
+                    },
                     "send": st.send.to_dict(),
                     "deliver": st.deliver.to_dict(),
                 }
@@ -265,6 +280,10 @@ class MeteredVan(VanWrapper):
                     "raw_bytes": st.raw_bytes,
                     "frame_bytes": st.frame_bytes,
                     "overhead_bytes": st.overhead_bytes,
+                    "verbs": {
+                        v: {"msgs": c[0], "bytes": c[1]}
+                        for v, c in st.verbs.items()
+                    },
                     "send": st.send.to_dict(),
                     "deliver": st.deliver.to_dict(),
                 }

@@ -477,6 +477,15 @@ class TelemetryAggregator:
             row["cmpr_pct"] = round(
                 100.0 * cum_snapshot.get("wire_bytes", 0) / raw, 2
             )
+        # hierarchical push (ISSUE 15): group-reduced PUSH fan-in — the
+        # wire applies a server saw as a % of the raw member pushes they
+        # stand for (100 = no pre-reduction, 25 = 4-member groups fully
+        # merged).  Off the server's CUMULATIVE group counters.
+        graw = cum_snapshot.get("group_members", 0)
+        if graw:
+            row["grp_pct"] = round(
+                100.0 * cum_snapshot.get("group_pushes", 0) / graw, 2
+            )
         if deliver.count:
             row["deliver_p99_ms"] = round(1e3 * deliver.percentile(0.99), 3)
             row["deliver_p50_ms"] = round(1e3 * deliver.percentile(0.50), 3)

@@ -30,6 +30,7 @@ from __future__ import annotations
 import bisect
 import dataclasses
 import functools
+import zlib
 from typing import Dict, Iterator, List, Tuple
 
 import numpy as np
@@ -59,6 +60,69 @@ BUSY_KEY = "__busy__"
 #: plane's relaxed-read contract).  Routing fences still apply: a
 #: read-only pull is never served from rows this server does not own.
 READ_ONLY_KEY = "__ro__"
+#: request payload key: hierarchical-push group stamp (ISSUE 15).  A dict
+#: ``{"op", "id", "n", "step", "ef", ...}`` riding worker-to-worker
+#: contribution/handoff/done CONTROL frames and the elected leader's wire
+#: PUSH.  On a PUSH it marks the frame as ONE logical apply for the whole
+#: group (``n`` = contributing members) — the server's dup policy and
+#: ApplyLedger already treat it as a single apply, and group accounting
+#: (``KVServer.counters()``) reads ``n`` for the fan-in ratio.  Pre-group
+#: servers ignore unknown payload keys, so stamped frames are
+#: rolling-upgrade safe (MIGRATION.md).  Mirrored as ``_GROUP_KEY`` in
+#: ``core/filters.py`` (import would cycle); test_group asserts equality.
+GROUP_KEY = "__grp__"
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkerGroup:
+    """Membership + deterministic per-step leader election (ISSUE 15).
+
+    A group is the static set of co-located workers that pre-reduce their
+    PUSH value planes before the wire.  :meth:`leader` is a pure function
+    of ``(table, step)`` — every member computes the same answer with no
+    coordination — and under ``"rotate"`` the elected leg rotates so wire
+    load spreads evenly; the crc32 table offset de-phases tables so a
+    multi-table step does not elect the same member for every table.
+
+    ``salt`` re-elects deterministically: the fence-retry loops pass the
+    attempt number, so a leader whose wire push was fenced mid-migration
+    hands the retry to the next member instead of hammering one leg.
+    """
+
+    members: Tuple[str, ...]
+    #: "rotate" (per-(table, step) rotation) or "fixed" (always member 0 —
+    #: the mode that keeps ISSUE-14 error-feedback residuals owned by one
+    #: sender; see ``config.GroupConfig``).
+    election: str = "rotate"
+
+    def __post_init__(self) -> None:
+        if not self.members:
+            raise ValueError("a worker group needs at least one member")
+        if len(set(self.members)) != len(self.members):
+            raise ValueError(f"duplicate group members: {self.members}")
+        if self.election not in ("rotate", "fixed"):
+            raise ValueError(
+                f"election must be rotate|fixed, got {self.election!r}"
+            )
+
+    @property
+    def size(self) -> int:
+        return len(self.members)
+
+    @property
+    def gid(self) -> str:
+        """Stable group id (member-derived; stamped onto group frames)."""
+        return "+".join(self.members)
+
+    def leader(self, table: str, step: int, salt: int = 0) -> str:
+        """The member elected to push ``table``'s reduced tensor at
+        ``step``; ``salt`` > 0 deterministically re-elects (fence retry)."""
+        if self.election == "fixed" and salt == 0:
+            return self.members[0]
+        idx = (zlib.crc32(table.encode()) + int(step) + int(salt)) % len(
+            self.members
+        )
+        return self.members[idx]
 
 
 @dataclasses.dataclass(frozen=True)

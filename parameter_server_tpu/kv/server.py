@@ -46,6 +46,7 @@ from parameter_server_tpu.kv.partition import RangePartition
 from parameter_server_tpu.kv.routing import (
     BUSY_KEY,
     FENCED_KEY,
+    GROUP_KEY,
     READ_ONLY_KEY,
     ROUTING_EPOCH_KEY,
     ROUTING_KEY,
@@ -158,6 +159,13 @@ class KVServer(Customer):
         #: dashboard counters
         self.pushes = 0
         self.pulls = 0
+        #: hierarchical push (ISSUE 15): group-stamped pushes applied, and
+        #: the member contributions they carried (``__grp__``'s ``n``) —
+        #: the fan-in ratio pstop's GRP column derives.  A group push is
+        #: ONE apply here (one ledger entry, one dup-policy unit); these
+        #: counters are what make the pre-reduction visible.
+        self.group_pushes = 0
+        self.group_members = 0
         #: serving plane (ISSUE 13): read-only fast-path pulls answered,
         #: and their per-table server-side latency (dispatch -> reply built,
         #: including the D2H readback — the histogram the ``ro-p99`` SLO
@@ -375,6 +383,11 @@ class KVServer(Customer):
         out = {
             "fenced_rejects": self.fenced_rejects,
             "ro_pulls": self.ro_pulls,
+            # hierarchical push (ISSUE 15): fan-in totals the telemetry
+            # plane derives grp_pct from (group-reduced applies / raw
+            # member contributions they replaced)
+            "group_pushes": self.group_pushes,
+            "group_members": self.group_members,
             "rows_migrated_in": self.rows_migrated_in,
             "rows_migrated_out": self.rows_migrated_out,
             "migration_freeze_s": round(self.migration_freeze_s, 6),
@@ -552,6 +565,13 @@ class KVServer(Customer):
         mode it deliberately blocks on the CHAIN ack, not on device work.)
         """
         self.pushes += 1
+        grp = msg.task.payload.get(GROUP_KEY)
+        if grp is not None:
+            # hierarchical push (ISSUE 15): this ONE apply stands for the
+            # whole group's step — count the fan-in so the wire reduction
+            # is reportable (pure dict/int ops: stays sync-free)
+            self.group_pushes += 1
+            self.group_members += int(grp.get("n") or 1)
         # staleness clock: every apply bumps the touched segments; the
         # ack carries the post-bump max so the pusher's next pulls can
         # be measured against a version it knows it contributed to

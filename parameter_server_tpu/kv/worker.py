@@ -10,8 +10,13 @@ Pipeline per call (SURVEY.md §3.2 hot path, TPU mapping):
 1. host: ``localize_to_slots`` — dedup keys, map to unique row slots
    (deterministic ``HashLocalizer`` for multi-worker consistency).
 2. device: ``segment_combine`` duplicate positions (push only) — the
-   worker-side pre-reduction; under a mesh this is where the DP ``psum``
-   lands (parallel/, later milestone).
+   worker-side pre-reduction.  With a :class:`~parameter_server_tpu.kv.
+   routing.WorkerGroup` (ISSUE 15) this is also where the GROUP
+   pre-reduction hangs: members hand their combined planes to the elected
+   leader, which reduces them (``core/coalesce.py::GroupReducer`` — an XLA
+   ``psum`` over a shared mesh where one exists, a deterministic
+   sorted-union merge over the loopback topology) so only ONE reduced
+   tensor crosses the wire per group per step.
 3. host: ``RoutingTable.slice_ids`` — split the sorted slot segment per
    OWNING server (the reference's ``Parameter::Slice``, but against the
    epoch-versioned routing table of PR 6, so ranges can move at runtime).
@@ -39,8 +44,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from parameter_server_tpu.config import TableConfig
+from parameter_server_tpu.config import GroupConfig, TableConfig
 from parameter_server_tpu.core import flightrec
+from parameter_server_tpu.core.coalesce import GroupReducer
 from parameter_server_tpu.core.messages import Message, Task, TaskKind, server_id
 from parameter_server_tpu.core.postoffice import Customer, Postoffice
 from parameter_server_tpu.kv.cache import HotRowCache
@@ -48,11 +54,13 @@ from parameter_server_tpu.kv.partition import RangePartition
 from parameter_server_tpu.kv.routing import (
     BUSY_KEY,
     FENCED_KEY,
+    GROUP_KEY,
     READ_ONLY_KEY,
     ROUTING_EPOCH_KEY,
     ROUTING_KEY,
     VERSION_KEY,
     RoutingTable,
+    WorkerGroup,
 )
 from parameter_server_tpu.ops import scatter
 from parameter_server_tpu.utils.keys import HashLocalizer, localize_to_slots
@@ -80,6 +88,8 @@ class KVWorker(Customer):
         max_fence_retries: int = 8,
         fence_backoff: float = 0.02,
         cache: Optional[HotRowCache] = None,
+        group: Optional[WorkerGroup] = None,
+        group_cfg: Optional[GroupConfig] = None,
     ) -> None:
         """``retry_on_timeout``: when a pull's deadline expires (dead or
         mid-promotion server), cancel the stuck task and re-issue it ONCE
@@ -96,7 +106,14 @@ class KVWorker(Customer):
         ``cache``: a :class:`~parameter_server_tpu.kv.cache.HotRowCache`
         turns this worker into a serving node (ISSUE 13): :meth:`pull_serve`
         answers hot keys locally, every stamped reply refreshes the cache's
-        invalidation watermark, and routing adoption drops all entries."""
+        invalidation watermark, and routing adoption drops all entries.
+
+        ``group``: a :class:`~parameter_server_tpu.kv.routing.WorkerGroup`
+        this worker belongs to (ISSUE 15).  Pushes then pre-reduce across
+        the group and only the elected leader's reduced tensor crosses the
+        wire — see :meth:`push` / :meth:`push_sync`.  ``group_cfg`` tunes
+        fallback/reduce behaviour (defaults to ``GroupConfig`` matched to
+        the group's size and election mode)."""
         super().__init__(name, post)
         #: host-side span recorder (Push/Pull latency histograms, SURVEY §5)
         self.tracer = tracer
@@ -148,6 +165,54 @@ class KVWorker(Customer):
         #: table -> (TableRouting identity, per-segment owner-code vector);
         #: memoizes the serve path's owner interning per adopted routing
         self._serve_codes: Dict[str, tuple] = {}
+        # -- hierarchical push (ISSUE 15) ------------------------------------
+        #: group membership; None (or size 1) = direct pushes
+        self._group = group if (group is not None and group.size > 1) else None
+        if self._group is not None:
+            if self.post.node_id not in self._group.members:
+                raise ValueError(
+                    f"{self.post.node_id} is not a member of group "
+                    f"{self._group.gid}"
+                )
+            if group_cfg is not None and group_cfg.size != self._group.size:
+                raise ValueError(
+                    f"group_cfg.size={group_cfg.size} != group size "
+                    f"{self._group.size}"
+                )
+            self._group_cfg = group_cfg or GroupConfig(
+                size=self._group.size, election=self._group.election
+            )
+            #: EF interaction with the quantized wire plane (ISSUE 14):
+            #: rotation would move the residual owner every step, so group
+            #: frames bypass the codec; fixed election pins one leader,
+            #: whose (sender, table) store then owns the group's residual
+            self._group_ef = (
+                "leader" if self._group.election == "fixed" else "bypass"
+            )
+            #: every member carries a reducer — any of them can be elected
+            self._group_reducer: Optional[GroupReducer] = GroupReducer(
+                self._group.size,
+                node=self.post.node_id,
+                mode=self._group_cfg.reduce,
+            )
+        else:
+            self._group_cfg = None
+            self._group_ef = None
+            self._group_reducer = None
+        self._group_lock = threading.Lock()
+        #: per-table local step counter keying leader election — members
+        #: advance in lockstep (the data-parallel training contract); skew
+        #: degrades to the timeout fallback, never to loss
+        self._group_steps: Dict[str, int] = {}
+        #: (table, step) -> Event set by the done notify (sync waiters)
+        self._group_events: Dict[Tuple[str, int], threading.Event] = {}
+        #: group counters (Dashboard-mergeable via :meth:`counters`)
+        self.group_pushes = 0  # reduced wire pushes sent (as leader)
+        self.group_reduced_fanin = 0  # member contributions those carried
+        self.group_contribs = 0  # contributions sent (as member)
+        self.group_fallbacks = 0  # degradations to direct push
+        self.group_done_recv = 0  # done notifies applied
+        self.group_handoffs = 0  # fence re-elections handed to a new leader
 
     def _serve_owner_codes(self, table: str, tr, cache) -> np.ndarray:
         """Owner :meth:`HotRowCache.server_code` per segment of ``tr``.
@@ -213,6 +278,17 @@ class KVWorker(Customer):
             "staleness_samples": self.staleness_samples,
             "busy_hints": self.busy_hints,
         }
+        if self._group is not None:
+            out.update(
+                {
+                    "group_pushes": self.group_pushes,
+                    "group_reduced_fanin": self.group_reduced_fanin,
+                    "group_contribs": self.group_contribs,
+                    "group_fallbacks": self.group_fallbacks,
+                    "group_done_recv": self.group_done_recv,
+                    "group_handoffs": self.group_handoffs,
+                }
+            )
         if self.cache is not None:
             out.update(self.cache.counters())
         return out
@@ -350,6 +426,473 @@ class KVWorker(Customer):
             "customer": self.name,
         }
 
+    # -- hierarchical push (ISSUE 15) ----------------------------------------
+    def _group_push(
+        self,
+        table: str,
+        slots: np.ndarray,
+        combined: np.ndarray,
+        *,
+        sync: bool,
+        timeout: Optional[float],
+    ) -> int:
+        """Route one prepared push through the group: elect, then either
+        lead the rendezvous or contribute to the elected leader.
+
+        Returns the submit timestamp of whatever leg THIS member sent this
+        step (the reduced wire push when leading and the set completed
+        locally, the contribution otherwise); ``-1`` when the leader is
+        still waiting on members (the completing deposit issues the wire
+        push from its own thread).
+        """
+        step = self._group_step_next(table)
+        leader = self._group.leader(table, step)
+        flightrec.record(
+            "group.elect",
+            node=self.post.node_id,
+            table=table,
+            step=step,
+            leader=leader,
+            size=self._group.size,
+        )
+        # flush rendezvous sets a dead/skewed member stranded (partial
+        # reduction — the contributions that DID arrive are never lost)
+        self._group_gc_stale()
+        if leader == self.post.node_id:
+            return self._group_lead(
+                table, step, slots, combined, sync=sync, timeout=timeout
+            )
+        return self._group_contribute(
+            table, step, leader, slots, combined, sync=sync, timeout=timeout
+        )
+
+    def _group_step_next(self, table: str) -> int:
+        with self._group_lock:
+            step = self._group_steps.get(table, 0)
+            self._group_steps[table] = step + 1
+        return step
+
+    def _group_event(self, table: str, step: int) -> threading.Event:
+        with self._group_lock:
+            ev = self._group_events.get((table, step))
+            if ev is None:
+                ev = self._group_events[(table, step)] = threading.Event()
+        return ev
+
+    def _group_pop_event(self, table: str, step: int) -> None:
+        with self._group_lock:
+            self._group_events.pop((table, step), None)
+
+    def _group_lead(
+        self, table, step, slots, combined, *, sync, timeout
+    ) -> int:
+        """Leader leg: deposit own contribution; push when the set
+        completes; on member timeout flush a PARTIAL reduction (no loss)."""
+        cfg = self._group_cfg
+        ev = self._group_event(table, step) if sync else None
+        done = self._group_reducer.deposit(
+            table, step, self.post.node_id, slots, combined
+        )
+        ts = -1
+        if done is not None:
+            ts = self._group_wire_push(table, step, *done)
+        if not sync:
+            return ts
+        try:
+            # the degradation decision runs on the group's own clock
+            # (fallback_timeout), not the caller's push deadline — chaos
+            # runs stay deterministic whatever timeout the test passes
+            if not ev.wait(cfg.fallback_timeout):
+                part = self._group_reducer.take(table, step)
+                if part is not None:
+                    if cfg.fallback == "none":
+                        raise TimeoutError(
+                            f"group push of {table!r} step {step}: members "
+                            f"missing and fallback='none'"
+                        )
+                    with self._group_lock:
+                        self.group_fallbacks += 1
+                    flightrec.record(
+                        "group.fallback",
+                        node=self.post.node_id,
+                        table=table,
+                        step=step,
+                        reason="member_timeout",
+                        fanin=part[2],
+                    )
+                    ts = self._group_wire_push(table, step, *part)
+                # either way the wire push is now in flight (here or from
+                # the completing deposit's thread); wait for its acks
+                if not ev.wait(timeout if timeout is not None else cfg.fallback_timeout):
+                    raise TimeoutError(
+                        f"group push of {table!r} step {step} timed out"
+                    )
+            return ts
+        finally:
+            self._group_pop_event(table, step)
+
+    def _group_contribute(
+        self, table, step, leader, slots, combined, *, sync, timeout
+    ) -> int:
+        """Member leg: ship the combined plane to the leader as a CONTROL
+        contribution (CoalescingVan passthrough — never bundled), degrade
+        to a direct push if the leader is dead or partitioned."""
+        cfg = self._group_cfg
+        ev = self._group_event(table, step) if sync else None
+        msg = Message(
+            task=Task(
+                TaskKind.CONTROL,
+                self.name,
+                payload={
+                    GROUP_KEY: {
+                        "op": "contrib",
+                        "table": table,
+                        "step": int(step),
+                        "member": self.post.node_id,
+                        "fanin": 1,
+                    }
+                },
+            ),
+            recver=leader,
+            keys=np.asarray(slots).astype(np.int64, copy=False),
+            values=[combined],
+        )
+        with self._group_lock:
+            self.group_contribs += 1
+        if not sync:
+            cb = functools.partial(
+                self._group_contrib_done, table, step, slots, combined
+            )
+            return self.submit([msg], callback=cb)
+        ts = self.submit([msg], keep_responses=True)
+        try:
+            if not self.wait(ts, cfg.fallback_timeout):
+                # partitioned leader (blackhole): fence the contribution so
+                # a late delivery cannot double-apply, then push direct
+                self.cancel(ts, "group leader deadline", remote=True)
+                self.take_responses(ts)
+                return self._group_fallback(
+                    table, step, slots, combined,
+                    reason="leader_timeout", sync=True, timeout=timeout,
+                )
+            errs = self.errors(ts)
+            self.take_responses(ts)
+            if errs:
+                # dead leader: the send failed outright (undeliverable) or
+                # its handler errored — the contribution was NOT absorbed
+                return self._group_fallback(
+                    table, step, slots, combined,
+                    reason="dead_leader", sync=True, timeout=timeout,
+                )
+            # acked: the leader owns this gradient now.  Wait for the done
+            # notify (which advances _last_push_version so staleness
+            # accounting sees the group push as our own).  No fallback
+            # after this point — re-pushing an absorbed gradient would
+            # double-apply; a lost done notify only costs bookkeeping.
+            ev.wait(timeout if timeout is not None else cfg.fallback_timeout)
+            return ts
+        finally:
+            self._group_pop_event(table, step)
+
+    def _group_contrib_done(self, table, step, slots, combined, responses):
+        """Async-contribution callback: degrade on a dead leader."""
+        ok = any(
+            r.task.payload.get("__error__") is None for r in responses
+        )
+        if not ok:
+            self._group_fallback(
+                table, step, slots, combined,
+                reason="dead_leader", sync=False, timeout=None,
+            )
+
+    def _group_fallback(
+        self, table, step, slots, combined, *, reason, sync, timeout
+    ) -> int:
+        """Direct per-worker push of this member's own gradient — the
+        same-step, no-loss degradation the group contract promises."""
+        if self._group_cfg.fallback == "none":
+            raise RuntimeError(
+                f"group push of {table!r} step {step}: leader unreachable "
+                f"({reason}) and fallback='none'"
+            )
+        with self._group_lock:
+            self.group_fallbacks += 1
+        flightrec.record(
+            "group.fallback",
+            node=self.post.node_id,
+            table=table,
+            step=step,
+            reason=reason,
+        )
+        if sync:
+            return self._push_sync_prepared(table, slots, combined, timeout)
+        ts, _ = self._submit_push(table, slots, combined)
+        return ts
+
+    def _group_gc_stale(self) -> None:
+        """Flush rendezvous sets whose stragglers exceeded the timeout."""
+        red = self._group_reducer
+        if red is None or not red.pending():
+            return
+        for table, step, (keys, vals, fanin) in red.take_stale(
+            self._group_cfg.fallback_timeout
+        ):
+            with self._group_lock:
+                self.group_fallbacks += 1
+            flightrec.record(
+                "group.fallback",
+                node=self.post.node_id,
+                table=table,
+                step=step,
+                reason="stale_set",
+                fanin=fanin,
+            )
+            self._group_wire_push(table, step, keys, vals, fanin)
+
+    def _group_wire_push(
+        self, table, step, keys, vals, fanin, attempt: int = 0,
+        positions: Optional[np.ndarray] = None,
+    ) -> int:
+        """Push the reduced tensor, stamped as ONE logical group apply.
+
+        Non-blocking by contract: this runs on driver threads, the
+        endpoint recv thread (a completing deposit), and the callback pool
+        (fence retries) — blocking here on a same-endpoint reply would
+        deadlock the LoopbackVan's single recv thread, so acks are handled
+        by :meth:`_group_wire_done` via the submit callback.
+        """
+        stamp = {
+            "id": self._group.gid,
+            "n": int(fanin),
+            "step": int(step),
+            "ef": self._group_ef,
+        }
+        tctx = self._trace_ctx()
+        routing = self.routing
+        keys = np.asarray(keys)
+        if positions is None:
+            positions = np.arange(keys.shape[0], dtype=np.int64)
+        sub = keys[positions]
+        msgs, order = [], {}
+        for s, rel, ids in routing.slice_ids(table, sub):
+            abs_pos = positions[rel]
+            order[server_id(s)] = abs_pos
+            msgs.append(
+                Message(
+                    task=Task(
+                        TaskKind.PUSH,
+                        self.name,
+                        payload={
+                            "table": table,
+                            "__trace__": tctx,
+                            ROUTING_EPOCH_KEY: routing.epoch,
+                            GROUP_KEY: dict(stamp),
+                        },
+                    ),
+                    recver=server_id(s),
+                    keys=ids.astype(np.int32),
+                    values=[vals[abs_pos]],
+                )
+            )
+        cb = functools.partial(
+            self._group_wire_done, table, step, keys, vals, fanin, attempt,
+            order,
+        )
+        with self.coalesce_window():
+            ts = self.submit(msgs, callback=cb)
+        with self._group_lock:
+            self.group_pushes += 1
+            self.group_reduced_fanin += int(fanin)
+        return ts
+
+    def _group_wire_done(
+        self, table, step, keys, vals, fanin, attempt, order, responses
+    ) -> None:
+        """Ack callback of a group wire push: adopt/re-elect on fences,
+        then broadcast the done notify carrying the acked versions.
+
+        Fence re-election (the ``push_many``/``push_sync`` contract): a
+        fenced reduced push re-elects with ``salt=attempt+1`` — if the new
+        leader is another member, the reduced subset is HANDED OFF so the
+        retry load rotates; the handoff degrades to a local retry if that
+        member is unreachable.
+        """
+        try:
+            self._adopt_from(responses)
+            data, _senders, fenced = self._scan_fences(responses, order)
+            vers = {}
+            for r in data:
+                p = r.task.payload
+                if p.get("__error__") is None:
+                    sver = p.get(VERSION_KEY)
+                    if sver is not None:
+                        vers[r.sender] = int(sver)
+            if fenced and attempt < self.max_fence_retries:
+                pos = np.sort(np.concatenate(fenced))
+                with self._group_lock:
+                    self.refresh_retries += 1
+                new_leader = self._group.leader(
+                    table, step, salt=attempt + 1
+                )
+                flightrec.record(
+                    "group.elect",
+                    node=self.post.node_id,
+                    table=table,
+                    step=step,
+                    leader=new_leader,
+                    size=self._group.size,
+                    salt=attempt + 1,
+                    cause="fence",
+                )
+                if new_leader != self.post.node_id:
+                    self._group_handoff(
+                        new_leader, table, step, keys[pos], vals[pos],
+                        fanin, attempt + 1,
+                    )
+                else:
+                    self._group_wire_push(
+                        table, step, keys, vals, fanin, attempt + 1,
+                        positions=pos,
+                    )
+            if fenced:
+                if vers:  # acked legs advance versions; retry notifies later
+                    self._group_notify_done(table, step, vers, final=False)
+            else:
+                self._group_notify_done(table, step, vers, final=True)
+        except Exception:  # noqa: BLE001 — a callback-thread error must not
+            # strand the group's sync waiters silently un-notified forever
+            flightrec.record(
+                "group.fallback",
+                node=self.post.node_id,
+                table=table,
+                step=step,
+                reason="wire_done_error",
+            )
+
+    def _group_handoff(
+        self, new_leader, table, step, keys, vals, fanin, attempt
+    ) -> None:
+        with self._group_lock:
+            self.group_handoffs += 1
+        msg = Message(
+            task=Task(
+                TaskKind.CONTROL,
+                self.name,
+                payload={
+                    GROUP_KEY: {
+                        "op": "handoff",
+                        "table": table,
+                        "step": int(step),
+                        "fanin": int(fanin),
+                        "attempt": int(attempt),
+                    }
+                },
+            ),
+            recver=new_leader,
+            keys=np.asarray(keys).astype(np.int64, copy=False),
+            values=[vals],
+        )
+        cb = functools.partial(
+            self._group_handoff_done, table, step, keys, vals, fanin, attempt
+        )
+        self.submit([msg], callback=cb)
+
+    def _group_handoff_done(
+        self, table, step, keys, vals, fanin, attempt, responses
+    ) -> None:
+        ok = any(
+            r.task.payload.get("__error__") is None for r in responses
+        )
+        if not ok:  # new leader unreachable too: retry the push locally
+            self._group_wire_push(table, step, keys, vals, fanin, attempt)
+
+    def _group_notify_done(self, table, step, vers, *, final) -> None:
+        """Tell every member the group push landed (fire-and-forget).
+
+        Carries the per-server acked versions so each member advances its
+        OWN ``_last_push_version`` — the group push is one logical apply
+        owned by the whole group, and the staleness plane (ISSUE 10) must
+        measure every member's update lag from it, not just the leader's.
+        """
+        self._group_apply_done(table, step, vers, final)
+        for m in self._group.members:
+            if m == self.post.node_id:
+                continue
+            self.post.send(
+                Message(
+                    task=Task(
+                        TaskKind.CONTROL,
+                        self.name,
+                        # fresh payload per leg (Loopback may alias them)
+                        payload={
+                            GROUP_KEY: {
+                                "op": "done",
+                                "table": table,
+                                "step": int(step),
+                                "vers": dict(vers),
+                                "final": bool(final),
+                            }
+                        },
+                    ),
+                    recver=m,
+                )
+            )
+
+    def _group_apply_done(self, table, step, vers, final) -> None:
+        with self._staleness_lock:
+            for server, sver in vers.items():
+                key = (table, server)
+                if int(sver) > self._last_push_version.get(key, 0):
+                    self._last_push_version[key] = int(sver)
+        with self._group_lock:
+            self.group_done_recv += 1
+            ev = self._group_events.get((table, int(step))) if final else None
+        if ev is not None:
+            ev.set()
+
+    def handle_request(self, msg: Message) -> Optional[Message]:
+        """Worker-to-worker group ops (ISSUE 15): contribution deposit,
+        fence-retry handoff, done notify.  Anything else keeps the base
+        behaviour (NotImplementedError -> typed ``__error__`` reply)."""
+        payload = msg.task.payload
+        grp = payload.get(GROUP_KEY) if isinstance(payload, dict) else None
+        if grp is None or self._group is None:
+            return super().handle_request(msg)
+        op = grp.get("op")
+        if op == "contrib":
+            table, step = grp["table"], int(grp["step"])
+            done = self._group_reducer.deposit(
+                table,
+                step,
+                grp.get("member", msg.sender),
+                msg.keys,
+                msg.values[0],
+                fanin=int(grp.get("fanin", 1)),
+            )
+            if done is not None:
+                self._group_wire_push(table, step, *done)
+            self._group_gc_stale()
+            return msg.reply()
+        if op == "handoff":
+            self._group_wire_push(
+                grp["table"],
+                int(grp["step"]),
+                msg.keys,
+                msg.values[0],
+                int(grp.get("fanin", 1)),
+                attempt=int(grp.get("attempt", 0)),
+            )
+            return msg.reply()
+        if op == "done":
+            self._group_apply_done(
+                grp["table"],
+                int(grp["step"]),
+                {k: int(v) for k, v in (grp.get("vers") or {}).items()},
+                bool(grp.get("final", True)),
+            )
+            return None  # fire-and-forget: the sender tracks no task
+        return super().handle_request(msg)
+
     # -- push ---------------------------------------------------------------
     def _submit_push(
         self,
@@ -416,12 +959,22 @@ class KVWorker(Customer):
         ``values`` has shape ``[len(keys), dim]`` (or ``[len(keys)]`` for
         dim=1 tables).  Fire-and-forget: cannot observe routing fences —
         under live migration use :meth:`push_sync`.
+
+        With a :class:`~parameter_server_tpu.kv.routing.WorkerGroup` the
+        push routes through the group pre-reduction instead (ISSUE 15):
+        non-leaders ship their combined plane to the elected leader, whose
+        reduced tensor is the only PUSH on the wire; a dead leader
+        degrades to a direct push via the submit callback (no loss).
         """
         tctx = self._trace_ctx()
         with self.tracer.span(
             "kv.push", table=table, n=int(keys.size), trace=tctx["tid"]
         ):
             slots, combined = self._prepare_push(table, keys, values)
+            if self._group is not None:
+                return self._group_push(
+                    table, slots, combined, sync=False, timeout=None
+                )
             ts, _ = self._submit_push(table, slots, combined, tctx=tctx)
             return ts
 
@@ -470,6 +1023,11 @@ class KVWorker(Customer):
         — one timestamp per table (responses from the same server must not
         share a ts), all of whose wire messages coalesce into one frame per
         server.  ``wait()`` each ts as usual.
+
+        Group mode (ISSUE 15): each table elects its own leader (the crc32
+        table offset in :meth:`~parameter_server_tpu.kv.routing.
+        WorkerGroup.leader` de-phases them), and fenced rejects of any
+        reduced push re-elect per table inside the ack callback.
         """
         with self.coalesce_window():
             return {
@@ -839,8 +1397,32 @@ class KVWorker(Customer):
         — the fence fired BEFORE any apply, so the retry cannot double-count
         and the accepted legs are never re-sent.  Returns the completing
         timestamp.
+
+        Group mode (ISSUE 15): the push routes through the group
+        pre-reduction and this call blocks until the group's done notify
+        (all members of a step must run :meth:`push_sync` concurrently —
+        the leader's rendezvous completes only when every contribution
+        lands).  Fenced rejects of the reduced push RE-ELECT
+        (``salt=attempt``) inside the leader's ack callback, handing the
+        retry to the next member; leader death degrades to this member's
+        own direct push within the same step.
         """
         slots, combined = self._prepare_push(table, keys, values)
+        if self._group is not None:
+            return self._group_push(
+                table, slots, combined, sync=True, timeout=timeout
+            )
+        return self._push_sync_prepared(table, slots, combined, timeout)
+
+    def _push_sync_prepared(
+        self,
+        table: str,
+        slots: np.ndarray,
+        combined: np.ndarray,
+        timeout: Optional[float] = None,
+    ) -> int:
+        """The direct (ungrouped) sync push loop over prepared planes —
+        also the group mode's no-loss degradation target."""
         positions: Optional[np.ndarray] = None
         ts = -1
         for attempt in range(self.max_fence_retries + 1):

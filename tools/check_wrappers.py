@@ -168,6 +168,12 @@ REQUIRED_EVENTS = frozenset({
     "compress.encode",
     "compress.decode",
     "compress.residual_reset",
+    # hierarchical push (ISSUE 15): pre-reduction, leader election, and
+    # the degradation-to-direct-push edge — dropping any of these would
+    # silence the group plane's observability
+    "group.reduce",
+    "group.elect",
+    "group.fallback",
 })
 
 #: ``np.<attr>`` calls that materialize a device array on the host.

@@ -55,6 +55,7 @@ ANOMALY_KINDS = frozenset({
     "slo.breach",
     "apply.backlog",
     "serve.shed",
+    "group.fallback",
 })
 
 

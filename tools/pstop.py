@@ -35,6 +35,10 @@ Columns:
                 — the serving plane's throughput column;
 - ``HIT%``      lifetime hot-row cache hit ratio (serving workers) —
                 ``-`` until the node has looked up at least one key;
+- ``GRP%``      group fan-in: wire PUSH applies as % of the raw member
+                pushes they stand for (servers; 100 = no pre-reduction,
+                25 = 4-member groups fully merged) — ``-`` until a
+                group-stamped push arrives;
 - ``SHED/S``    reads shed by admission control per second (serving
                 workers; the ``serve.shed`` event rate);
 - ``DRP``       cumulative telemetry frames the aggregator dropped for
@@ -67,7 +71,8 @@ _CLEAR = "\x1b[2J\x1b[H"
 _HEADER = (
     f"{'NODE':<10} {'SEQ':>5} {'AGE':>6} {'MSG/S':>8} {'KB/S':>9} "
     f"{'P99ms':>8} {'STALE p50/p99':>14} {'INF':>4} {'BKLG':>6} "
-    f"{'APLYms':>7} {'RO/S':>7} {'HIT%':>5} {'CMPR%':>6} {'SHED/S':>7} "
+    f"{'APLYms':>7} {'RO/S':>7} {'HIT%':>5} {'CMPR%':>6} {'GRP%':>6} "
+    f"{'SHED/S':>7} "
     f"{'DRP':>4} {'MIG':>3} {'SLO':<18} FLAGS"
 )
 
@@ -204,6 +209,9 @@ def render(latest: Dict[str, dict], now: Optional[float] = None) -> List[str]:
         # quantized wire plane: compressed bytes as % of raw (lifetime-
         # cumulative, derived by the aggregator from MeteredVan counters)
         cmpr = row.get("cmpr_pct")
+        # hierarchical push: group-reduced PUSH requests as % of the raw
+        # member pushes they carry (lifetime-cumulative, servers only)
+        grp = row.get("grp_pct")
         shed_s = row.get("shed_per_s")
         drops = (row.get("ctl") or {}).get("drops")
         healthy = row.get("healthy")
@@ -227,6 +235,7 @@ def render(latest: Dict[str, dict], now: Optional[float] = None) -> List[str]:
             f"{f'{ro_s:.1f}' if ro_s is not None else '-':>7} "
             f"{f'{hitp:.1f}' if hitp is not None else '-':>5} "
             f"{f'{cmpr:.1f}' if cmpr is not None else '-':>6} "
+            f"{f'{grp:.1f}' if grp is not None else '-':>6} "
             f"{f'{shed_s:.1f}' if shed_s is not None else '-':>7} "
             f"{int(drops) if drops is not None else '-':>4} "
             f"{mig:>3} {slo:<18} {flags}"
