@@ -20,6 +20,36 @@ offset.  Restore is elastic: the new server count may differ from the saved
 one — each restoring server reads exactly the old shard files overlapping its
 new row-range and slices them (the re-shard path of SURVEY.md §5 elastic
 recovery).
+
+Durability plane (ISSUE 16) — the partitioned snapshot format (format 2)::
+
+    <root>/snap_000042/
+        MANIFEST.json                     # written LAST, CRC-armored
+        w.seg00000000-00000250.npz        # one file per routing SEGMENT
+        w.delta.s1.npz                    # dirty-row delta log (per server)
+
+Differences from the legacy uniform layout:
+
+- **partitioned**: one file per ``RoutingTable`` segment, written by the
+  segment's OWNER, so any post-migration layout can snapshot (the legacy
+  format refuses non-uniform fleets with :class:`CheckpointLayoutError`);
+- **incremental**: every segment entry records its ``__sver__`` version
+  clock (the per-segment LSN) at commit time; a later snapshot whose
+  segment version has not advanced carries the OLD file forward instead of
+  rewriting it, and rows written during the snapshot window ride a dirty
+  delta log.  Per-entry ``step`` stamps order the replay: a delta applies
+  to a row only when it is at least as new as the row's covering segment
+  file, so a chain of incrementals restores bit-identical to a full save;
+- **CRC-armored**: the manifest records a crc32 per referenced file and
+  one over its own body; :func:`finalize_snapshot` verifies every file
+  (existence, CRC, exact tiling of the row space) BEFORE the manifest is
+  written, so a manifest can never reference a torn file, and
+  :func:`read_snapshot` / :func:`snapshot_rows` re-verify on restore
+  (:class:`CheckpointCorruptError`);
+- **any fleet shape**: :func:`snapshot_rows` assembles an arbitrary global
+  row range from whatever segment files overlap it (the redistribution
+  schedule of PAPERS.md arXiv:2112.01075 — each new owner reads only the
+  file ranges it owns), so restore reshards onto any new routing table.
 """
 
 from __future__ import annotations
@@ -28,7 +58,8 @@ import dataclasses
 import json
 import os
 import tempfile
-from typing import Any, Dict, List, Optional
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -36,7 +67,32 @@ from parameter_server_tpu.kv.partition import RangePartition
 from parameter_server_tpu.kv.table import KVTable
 
 _STEP_PREFIX = "step_"
+_SNAP_PREFIX = "snap_"
 _MANIFEST = "MANIFEST.json"
+
+#: partitioned-snapshot manifest format (bumped on incompatible layout
+#: changes; see MIGRATION.md "Snapshot format versioning").
+SNAP_FORMAT = 2
+
+
+class CheckpointLayoutError(RuntimeError):
+    """The table layout cannot be saved in the requested checkpoint format.
+
+    Raised (typed, not an opaque assert) by ``KVServer.save_checkpoint``
+    when a post-migration fleet hits the legacy uniform-contiguous shard
+    format — the caller should use the partitioned snapshot path
+    (``KVWorker.save_snapshot``) instead.
+    """
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A snapshot file or manifest failed its CRC/consistency check.
+
+    Torn files (a server killed mid-write), bit rot, and truncated
+    manifests all land here — restore-source selection treats the snapshot
+    as absent and falls back to the next source rather than loading
+    corrupt rows.
+    """
 
 
 def _step_dir(root: str, step: int) -> str:
@@ -298,3 +354,395 @@ def retain(root: str, keep: int) -> None:
     steps = list_steps(root)
     for step in steps if keep == 0 else steps[:-keep]:
         shutil.rmtree(_step_dir(root, step), ignore_errors=True)
+
+
+# -- durability plane: partitioned / incremental snapshots (ISSUE 16) --------
+def _snap_dir(root: str, step: int) -> str:
+    return os.path.join(root, f"{_SNAP_PREFIX}{step:06d}")
+
+
+def _file_crc(path: str) -> int:
+    """Streaming crc32 of a file's bytes (the torn-file armor)."""
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(1 << 20)
+            if not block:
+                return crc
+            crc = zlib.crc32(block, crc)
+
+
+def _atomic_npz(snap_dir: str, path: str, arrays: Dict[str, np.ndarray]) -> None:
+    fd, tmp = tempfile.mkstemp(dir=snap_dir, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **arrays)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def write_segment_file(
+    root: str,
+    step: int,
+    table_name: str,
+    lo: int,
+    hi: int,
+    value: np.ndarray,
+    state: Dict[str, np.ndarray],
+) -> dict:
+    """Write one routing segment's rows ``[lo, hi)`` (value + opt state).
+
+    Written by the segment's OWNING server; safe concurrently because every
+    segment has exactly one owner and writes go through an adjacent temp
+    name + atomic rename.  Returns the manifest segment entry (without the
+    commit-time ``sver`` stamp, which the driver fills in at finalize).
+    """
+    if value.shape[0] != hi - lo:
+        raise ValueError(
+            f"segment [{lo}, {hi}) of {table_name!r}: value has "
+            f"{value.shape[0]} rows"
+        )
+    snap_dir = _snap_dir(root, step)
+    os.makedirs(snap_dir, exist_ok=True)
+    fname = f"{table_name}.seg{lo:08d}-{hi:08d}.npz"
+    path = os.path.join(snap_dir, fname)
+    arrays = {
+        "value": np.asarray(value),
+        "row_offset": np.asarray(lo, dtype=np.int64),
+    }
+    for k, v in state.items():
+        arrays[f"state.{k}"] = np.asarray(v)
+    _atomic_npz(snap_dir, path, arrays)
+    return {
+        "table": table_name,
+        "lo": int(lo),
+        "hi": int(hi),
+        "step": int(step),
+        "file": f"{_SNAP_PREFIX}{step:06d}/{fname}",
+        "crc": _file_crc(path),
+        "bytes": os.path.getsize(path),
+        "sver": 0,
+    }
+
+
+def write_delta_file(
+    root: str,
+    step: int,
+    table_name: str,
+    writer: int,
+    rows: np.ndarray,
+    value: np.ndarray,
+    state: Dict[str, np.ndarray],
+) -> Optional[dict]:
+    """Write a dirty-row delta log: rows written DURING the snapshot window.
+
+    ``writer`` disambiguates concurrent writers (one delta file per server
+    per table per step).  Returns the manifest delta entry, or None when
+    there is nothing to log.
+    """
+    rows = np.asarray(rows, dtype=np.int64)
+    if rows.size == 0:
+        return None
+    snap_dir = _snap_dir(root, step)
+    os.makedirs(snap_dir, exist_ok=True)
+    fname = f"{table_name}.delta.s{writer}.npz"
+    path = os.path.join(snap_dir, fname)
+    arrays = {"rows": rows, "value": np.asarray(value)}
+    for k, v in state.items():
+        arrays[f"state.{k}"] = np.asarray(v)
+    _atomic_npz(snap_dir, path, arrays)
+    return {
+        "table": table_name,
+        "step": int(step),
+        "file": f"{_SNAP_PREFIX}{step:06d}/{fname}",
+        "crc": _file_crc(path),
+        "bytes": os.path.getsize(path),
+        "rows": int(rows.size),
+    }
+
+
+def _manifest_crc(body: dict) -> int:
+    return zlib.crc32(
+        json.dumps(body, sort_keys=True, separators=(",", ":")).encode()
+    )
+
+
+def _verify_entry(root: str, entry: dict) -> str:
+    """Existence + CRC check of one referenced file; returns its path."""
+    path = os.path.join(root, entry["file"])
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"snapshot references missing file: {entry['file']}"
+        )
+    crc = _file_crc(path)
+    if crc != int(entry["crc"]):
+        raise CheckpointCorruptError(
+            f"torn/corrupt snapshot file {entry['file']}: "
+            f"crc {crc} != manifest {entry['crc']}"
+        )
+    return path
+
+
+def finalize_snapshot(
+    root: str,
+    step: int,
+    routing_payload: dict,
+    segments: List[dict],
+    deltas: List[dict],
+    *,
+    base_step: Optional[int] = None,
+    clocks: Optional[List[int]] = None,
+    extras: Optional[Dict[str, Any]] = None,
+) -> None:
+    """Driver commit: verify every referenced file, then write the manifest.
+
+    The torn-file contract: a server killed mid-snapshot leaves either a
+    missing segment (FileNotFoundError here) or a temp file no entry names
+    — either way the manifest is never written, ``latest_snapshot`` never
+    sees the step, and the previous snapshot stays the restore point.
+    Verification also re-checks CARRIED entries (files living in older snap
+    dirs), so an incremental chain cannot commit over a rotted base.
+    """
+    by_table: Dict[str, List[dict]] = {}
+    for e in segments:
+        by_table.setdefault(e["table"], []).append(e)
+    for t, blob in routing_payload["tables"].items():
+        rows = int(blob["rows"])
+        entries = sorted(by_table.get(t, []), key=lambda e: e["lo"])
+        cursor = 0
+        for e in entries:
+            if int(e["lo"]) != cursor:
+                raise CheckpointCorruptError(
+                    f"snapshot of {t!r} has a segment gap/overlap at row "
+                    f"{cursor} (next entry starts at {e['lo']})"
+                )
+            cursor = int(e["hi"])
+        if cursor != rows:
+            raise CheckpointCorruptError(
+                f"snapshot of {t!r} covers [0, {cursor}) of {rows} rows"
+            )
+    for entry in list(segments) + list(deltas):
+        _verify_entry(root, entry)
+    body = {
+        "format": SNAP_FORMAT,
+        "step": int(step),
+        "base_step": None if base_step is None else int(base_step),
+        "routing": routing_payload,
+        "segments": sorted(
+            segments, key=lambda e: (e["table"], e["lo"])
+        ),
+        "deltas": sorted(deltas, key=lambda e: (e["step"], e["table"])),
+        "clocks": list(clocks or []),
+        "extras": dict(extras or {}),
+    }
+    snap_dir = _snap_dir(root, step)
+    os.makedirs(snap_dir, exist_ok=True)
+    manifest = dict(body, crc=_manifest_crc(body))
+    tmp = os.path.join(snap_dir, _MANIFEST + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=1)
+    os.replace(tmp, os.path.join(snap_dir, _MANIFEST))
+
+
+def list_snapshots(root: str) -> List[int]:
+    """Committed partitioned-snapshot steps, ascending (no CRC check)."""
+    if not os.path.isdir(root):
+        return []
+    steps = []
+    for name in os.listdir(root):
+        if not name.startswith(_SNAP_PREFIX):
+            continue
+        if not os.path.exists(os.path.join(root, name, _MANIFEST)):
+            continue  # aborted save
+        try:
+            steps.append(int(name[len(_SNAP_PREFIX):]))
+        except ValueError:
+            continue
+    return sorted(steps)
+
+
+def read_snapshot(root: str, step: int) -> dict:
+    """Load + CRC-verify a snapshot manifest (raises on corruption)."""
+    try:
+        with open(os.path.join(_snap_dir(root, step), _MANIFEST)) as f:
+            m = json.load(f)
+    except json.JSONDecodeError as e:
+        raise CheckpointCorruptError(
+            f"snapshot {step} manifest is not valid JSON: {e}"
+        ) from e
+    if m.get("format") != SNAP_FORMAT:
+        raise CheckpointCorruptError(
+            f"snapshot {step} has format {m.get('format')!r}; this build "
+            f"reads format {SNAP_FORMAT} (see MIGRATION.md)"
+        )
+    crc = m.pop("crc", None)
+    if crc != _manifest_crc(m):
+        raise CheckpointCorruptError(
+            f"snapshot {step} manifest failed its CRC check "
+            f"(recorded {crc})"
+        )
+    return m
+
+
+def latest_snapshot(root: str) -> Optional[int]:
+    """Newest snapshot whose manifest verifies; skips corrupt ones."""
+    for step in reversed(list_snapshots(root)):
+        try:
+            read_snapshot(root, step)
+            return step
+        except (OSError, ValueError, CheckpointCorruptError):
+            continue
+    return None
+
+
+def snapshot_rows(
+    root: str, manifest: dict, table_name: str, lo: int, hi: int
+) -> Tuple[np.ndarray, Dict[str, np.ndarray]]:
+    """Assemble global rows ``[lo, hi)`` of ``table_name`` from a snapshot.
+
+    The reshard-restore core: reads only the segment files OVERLAPPING the
+    requested range (each is CRC-verified first), then replays the delta
+    logs in step order — a delta row applies only when its stamp is at
+    least as new as the row's covering segment file, which is what makes an
+    incremental chain restore bit-identical to a full snapshot.
+    """
+    n = hi - lo
+    if n <= 0:
+        raise ValueError(f"bad range [{lo}, {hi})")
+    value: Optional[np.ndarray] = None
+    state: Dict[str, np.ndarray] = {}
+    seg_step = np.zeros(n, dtype=np.int64)
+    covered = np.zeros(n, dtype=bool)
+    for e in manifest["segments"]:
+        if e["table"] != table_name:
+            continue
+        a, b = max(lo, int(e["lo"])), min(hi, int(e["hi"]))
+        if a >= b:
+            continue
+        path = _verify_entry(root, e)
+        with np.load(path) as z:
+            if int(z["row_offset"]) != int(e["lo"]):
+                raise CheckpointCorruptError(
+                    f"{e['file']}: row_offset {int(z['row_offset'])} != "
+                    f"manifest lo {e['lo']}"
+                )
+            sl = slice(a - int(e["lo"]), b - int(e["lo"]))
+            if value is None:
+                v = z["value"]
+                value = np.zeros((n, v.shape[1]), dtype=v.dtype)
+                state = {
+                    k[len("state."):]: np.zeros((n, v.shape[1]), dtype=v.dtype)
+                    for k in z.files
+                    if k.startswith("state.")
+                }
+            value[a - lo : b - lo] = z["value"][sl]
+            for k in state:
+                state[k][a - lo : b - lo] = z[f"state.{k}"][sl]
+        seg_step[a - lo : b - lo] = int(e["step"])
+        covered[a - lo : b - lo] = True
+    if value is None or not covered.all():
+        missing = int(n if value is None else (~covered).sum())
+        raise CheckpointCorruptError(
+            f"snapshot of {table_name!r}: {missing} rows of [{lo}, {hi}) "
+            "not covered by any segment file"
+        )
+    for d in sorted(manifest["deltas"], key=lambda e: int(e["step"])):
+        if d["table"] != table_name:
+            continue
+        path = _verify_entry(root, d)
+        with np.load(path) as z:
+            rows = np.asarray(z["rows"], dtype=np.int64)
+            m = (rows >= lo) & (rows < hi)
+            if not m.any():
+                continue
+            r = rows[m] - lo
+            newer = int(d["step"]) >= seg_step[r]
+            r = r[newer]
+            if r.size == 0:
+                continue
+            value[r] = z["value"][m][newer]
+            for k in state:
+                state[k][r] = z[f"state.{k}"][m][newer]
+    return value, state
+
+
+def restore_segments(
+    root: str,
+    manifest: dict,
+    table_name: str,
+    segments: List[Tuple[int, int]],
+    table: KVTable,
+) -> None:
+    """Load a server's owned ``[(lo, hi), ...]`` ranges into ``table``.
+
+    The restore-to-any-fleet-shape path: ``segments`` comes from the NEW
+    routing table and need not match the saved layout — each range is
+    assembled from whatever files overlap it.  The trash row is rebuilt
+    from optimizer init fills, exactly as the legacy restore does.
+    """
+    pieces = [
+        snapshot_rows(root, manifest, table_name, lo, hi)
+        for lo, hi in segments
+        if hi > lo
+    ]
+    dtype = np.asarray(table.value).dtype
+    if pieces:
+        value = np.concatenate([v for v, _ in pieces], axis=0)
+        state = {
+            k: np.concatenate([s[k] for _, s in pieces], axis=0)
+            for k in pieces[0][1]
+        }
+    else:
+        value = np.zeros((0, table.dim), dtype)
+        state = {k: np.zeros((0, table.dim), dtype) for k in table.state}
+    table.install_rows(value.astype(dtype, copy=False), state)
+
+
+def retain_snapshots(root: str, keep: int) -> None:
+    """Delete old snapshot dirs, preserving incremental-chain references.
+
+    Keeps the newest ``keep`` committed snapshots PLUS any older snap dir
+    their manifests still reference (carried segment files / delta logs) —
+    an incremental chain must never lose its base out from under it.
+    ``keep=0`` deletes everything; negative is an error.
+
+    Aborted snapshots (a snap dir with segment files but no manifest — a
+    server died mid-write, or the driver aborted) are swept too, but only
+    at steps BELOW the newest committed one: an in-flight snapshot always
+    targets a step above everything committed, so its pre-commit files are
+    never yanked by a concurrent retention pass.
+    """
+    import shutil
+
+    if keep < 0:
+        raise ValueError(f"retain_snapshots: keep must be >= 0, got {keep}")
+    steps = list_snapshots(root)
+    kept = set() if keep == 0 else set(steps[-keep:])
+    referenced = set()
+    for step in kept:
+        try:
+            m = read_snapshot(root, step)
+        except (OSError, ValueError, CheckpointCorruptError):
+            continue
+        for e in list(m["segments"]) + list(m["deltas"]):
+            referenced.add(str(e["file"]).split("/", 1)[0])
+    for step in steps:
+        if step in kept or f"{_SNAP_PREFIX}{step:06d}" in referenced:
+            continue
+        shutil.rmtree(_snap_dir(root, step), ignore_errors=True)
+    if steps:
+        newest = steps[-1]
+        for name in os.listdir(root):
+            if not name.startswith(_SNAP_PREFIX) or name in referenced:
+                continue
+            if os.path.exists(os.path.join(root, name, _MANIFEST)):
+                continue
+            try:
+                aborted = int(name[len(_SNAP_PREFIX):])
+            except ValueError:
+                continue
+            if aborted < newest:
+                shutil.rmtree(os.path.join(root, name), ignore_errors=True)

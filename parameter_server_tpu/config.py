@@ -317,6 +317,54 @@ class GroupConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class CheckpointConfig:
+    """Durability plane knobs (ISSUE 16): partitioned snapshot cadence.
+
+    The partitioned snapshot path (``KVWorker.save_snapshot`` +
+    ``checkpoint.finalize_snapshot``) snapshots ANY routing layout — one
+    file per segment, an incremental carry when a segment's ``__sver__``
+    clock has not advanced, and a dirty-row delta log that bounds the
+    commit freeze.  This config feeds the ElasticTrainer's checkpoint loop
+    and the ``durability_plane_specs`` SLO (``ckpt_age_s`` breaches when
+    the last durable manifest is older than ``interval_s``).
+    """
+
+    #: target wall-clock seconds between durable manifests; the
+    #: ``ckpt-age`` SLO breach threshold derives from it.
+    interval_s: float = 60.0
+    #: soft bound on the dirty-row delta a snapshot commit may export in
+    #: its freeze window; a commit over the bound still lands (durability
+    #: beats latency) but flags ``over_bound`` on its ``ckpt.commit``
+    #: event and bumps the ``ckpt_delta_overflow`` counter.
+    max_delta_rows: int = 65536
+    #: snapshots kept by ``checkpoint.retain_snapshots`` (chain bases that
+    #: kept manifests still reference are preserved regardless).
+    retention: int = 3
+    #: "auto" = legacy uniform shards while the layout allows them, the
+    #: partitioned path once the fleet has rebalanced (or a snapshot chain
+    #: exists to extend); "partitioned"/"legacy" force one path.
+    mode: str = "auto"
+
+    def __post_init__(self) -> None:
+        if self.interval_s <= 0:
+            raise ValueError(
+                f"interval_s must be > 0, got {self.interval_s!r}"
+            )
+        if self.max_delta_rows < 1:
+            raise ValueError(
+                f"max_delta_rows must be >= 1, got {self.max_delta_rows!r}"
+            )
+        if self.retention < 0:
+            raise ValueError(
+                f"retention must be >= 0, got {self.retention!r}"
+            )
+        if self.mode not in ("auto", "legacy", "partitioned"):
+            raise ValueError(
+                f"mode must be auto|legacy|partitioned, got {self.mode!r}"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
 class TableConfig:
     """A KV table: the unit the reference range-partitions across servers.
 

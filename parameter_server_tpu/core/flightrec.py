@@ -117,6 +117,17 @@ EVENTS = frozenset({
     "group.reduce",
     "group.elect",
     "group.fallback",
+    # durability plane (ISSUE 16, checkpoint.py + kv/server.py snapshot
+    # ops): snapshot window armed / one segment file written (or carried
+    # forward unchanged) / dirty-delta exported under the commit freeze /
+    # a shard restored from a partitioned snapshot / a snapshot window
+    # torn down without committing (server death, routing change mid-
+    # snapshot, driver abort — the postmortem anomaly anchor)
+    "ckpt.begin",
+    "ckpt.segment",
+    "ckpt.commit",
+    "ckpt.restore",
+    "ckpt.abort",
 })
 
 #: env var: when set, recv-thread exceptions auto-dump a bundle here.
@@ -410,4 +421,5 @@ def anomaly_kinds() -> frozenset:
         "apply.backlog",
         "serve.shed",
         "group.fallback",
+        "ckpt.abort",
     })

@@ -486,6 +486,13 @@ class TelemetryAggregator:
             row["grp_pct"] = round(
                 100.0 * cum_snapshot.get("group_pushes", 0) / graw, 2
             )
+        # durability plane (ISSUE 16): snapshot staleness as a first-class
+        # derived field.  The server reports ckpt_age_s as a GAUGE (seconds
+        # since last snap_commit/restore), so the reconstructed cumulative
+        # value IS the age — surface it for pstop's CKPT column and the
+        # ckpt-age SLO without any extra plumbing.
+        if "ckpt_age_s" in cum_snapshot:
+            row["ckpt_age_s"] = round(float(cum_snapshot["ckpt_age_s"]), 3)
         if deliver.count:
             row["deliver_p99_ms"] = round(1e3 * deliver.percentile(0.99), 3)
             row["deliver_p50_ms"] = round(1e3 * deliver.percentile(0.50), 3)

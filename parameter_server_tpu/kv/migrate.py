@@ -74,6 +74,11 @@ class ShardMigrator(Customer):
             "migrations": self.migrations,
             "migration_aborts": self.aborts,
             "rows_moved": self.rows_moved,
+            # the dirty-delta-bounded commit freeze; the durability plane's
+            # snapshot commit (kv/server.py snap_commit) reuses exactly this
+            # dirty-tracking/bounded-freeze pattern, reported as
+            # ckpt_freeze_s in the server's own counters
+            "freeze_s_last": round(self.freeze_s_last, 6),
         }
 
     # -- low-level control RPC ------------------------------------------------

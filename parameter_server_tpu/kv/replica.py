@@ -167,9 +167,11 @@ def restart_same_id(
        the new binding — peers reset seq windows for frames FROM ``S{i}``
        and fence any zombie frames of the dead process.
 
-    Returns ``(server, source)`` with source in {"replica", "checkpoint",
-    "cold"}.  The new server re-chains to the standby's id when a standby
-    is passed, so protection continues after the restart.
+    Returns ``(server, source)`` with source in {"replica", "partitioned",
+    "checkpoint", "cold"} — replica chain first, then the partitioned
+    durability-plane snapshot, then the legacy uniform checkpoint.  The new
+    server re-chains to the standby's id when a standby is passed, so
+    protection continues after the restart.
     """
     primary_id = f"S{server_index}"
     # .fw = replica-forwarding client, .mig = migration-streaming client —
@@ -207,12 +209,29 @@ def restart_same_id(
     else:
         from parameter_server_tpu import checkpoint
 
-        step = None if ckpt_root is None else checkpoint.latest_step(ckpt_root)
-        if step is not None:
-            server.restore_checkpoint(ckpt_root, step)
-            source = "checkpoint"
-        else:
-            source = "cold"
+        # restore-source ordering: replica chain (freshest, handled above)
+        # > partitioned snapshot (any layout, incremental-aware) > legacy
+        # uniform checkpoint > cold.  A corrupt/torn snapshot falls through
+        # to the next source instead of wedging the restart.
+        source = "cold"
+        if ckpt_root is not None:
+            snap = checkpoint.latest_snapshot(ckpt_root)
+            if snap is not None:
+                try:
+                    # adopt the manifest's routing: the restarted server
+                    # must rejoin at the fleet's (snapshot-time) epoch or
+                    # it would not own its migrated segments
+                    server.restore_snapshot(
+                        ckpt_root, snap, adopt_routing=True
+                    )
+                    source = "partitioned"
+                except (OSError, checkpoint.CheckpointCorruptError):
+                    source = "cold"
+            if source == "cold":
+                step = checkpoint.latest_step(ckpt_root)
+                if step is not None:
+                    server.restore_checkpoint(ckpt_root, step)
+                    source = "checkpoint"
         if hasattr(van, "drop_inbound_state"):
             van.drop_inbound_state(primary_id)
     logging.getLogger(__name__).info(

@@ -173,6 +173,18 @@ class TableRouting:
     def distinct_owners(self) -> Tuple[int, ...]:
         return tuple(sorted(set(self.owners)))
 
+    def segments(self) -> List[Tuple[int, int, int]]:
+        """All segments as ``[(lo, hi, owner), ...]`` in row order.
+
+        The durability plane's iteration unit (ISSUE 16): a partitioned
+        snapshot writes exactly one file per entry here, owned by
+        ``owner``, so any layout this table can express can snapshot.
+        """
+        return [
+            (int(self.offsets[i]), int(self.offsets[i + 1]), int(o))
+            for i, o in enumerate(self.owners)
+        ]
+
     def owned_segments(self, server: int) -> List[Tuple[int, int]]:
         """``[(lo, hi), ...]`` global ranges owned by ``server``, in order."""
         return [

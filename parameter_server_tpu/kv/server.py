@@ -185,6 +185,23 @@ class KVServer(Customer):
         self._migrations: Dict[str, dict] = {}
         #: in-flight recipient staging: mid -> {table, lo, hi, chunks}
         self._staging: Dict[str, dict] = {}
+        #: durability plane (ISSUE 16): open snapshot windows, sid ->
+        #: {dirty: {table: set(global rows)}} — armed by ``snap_begin``,
+        #: drained by ``snap_commit``'s bounded freeze.  Same recv-thread
+        #: single-writer discipline as ``_migrations``.
+        self._snapshots: Dict[str, dict] = {}
+        self.ckpt_commits = 0
+        self.ckpt_freeze_s = 0.0
+        self.ckpt_freeze_last_s = 0.0
+        self.ckpt_delta_rows = 0
+        self.ckpt_delta_overflow = 0
+        #: soft bound on the commit-freeze delta (CheckpointConfig
+        #: ``max_delta_rows``; settable per snap_begin payload).
+        self.ckpt_max_delta_rows = 65536
+        #: basis of the ``ckpt_age_s`` gauge: stamped at construction so a
+        #: fleet that NEVER snapshots ages (and breaches the ckpt-age SLO)
+        #: from boot, then re-stamped on every snapshot commit / restore.
+        self._ckpt_commit_t = time.monotonic()
         #: lazy side customer for donor->recipient streaming (own endpoint:
         #: waiting for stage/install acks on this recv thread would deadlock)
         self._mig: Optional[Customer] = None
@@ -396,6 +413,15 @@ class KVServer(Customer):
             "seg_version_max": sum(
                 self.version_max(t) for t in self.tables
             ),
+            # durability plane (ISSUE 16): seconds since this shard last
+            # committed to (or restored from) a durable snapshot — the
+            # gauge pstop's CKPT column and the ckpt-age SLO watch —
+            # plus commit totals and the bounded-freeze accounting
+            "ckpt_age_s": round(time.monotonic() - self._ckpt_commit_t, 3),
+            "ckpt_commits": self.ckpt_commits,
+            "ckpt_freeze_s": round(self.ckpt_freeze_s, 6),
+            "ckpt_delta_rows": self.ckpt_delta_rows,
+            "ckpt_delta_overflow": self.ckpt_delta_overflow,
         }
         if self.ledger is not None:
             # device-plane gauges + totals (inflight_bundles/rows,
@@ -589,6 +615,17 @@ class KVServer(Customer):
                 if m["table"] == tname:
                     hit = kn[(kn >= m["lo"]) & (kn < m["hi"])]
                     m["dirty"].update(int(x) for x in hit)
+        if self._snapshots:
+            # durability plane: rows written during an open snapshot
+            # window go stale against the already-written segment files —
+            # snap_commit re-exports exactly this set as the delta log,
+            # which is what bounds the commit freeze (pure host set ops:
+            # stays sync-free, same as the migration tracking above)
+            hit = kn[kn < self.routing.tables[tname].rows]
+            for sn in self._snapshots.values():
+                sn["dirty"].setdefault(tname, set()).update(
+                    int(x) for x in hit
+                )
         if self.replica is not None:
             # forward AFTER the local apply, in apply order (this recv
             # thread is the only writer), so the standby replays the
@@ -1037,6 +1074,17 @@ class KVServer(Customer):
         newly-adopted ranges (the migration payload).  Runs on the recv
         thread, so it is atomic wrt pushes.
         """
+        # durability plane: a routing change invalidates every open
+        # snapshot's segment bookkeeping (files already written describe
+        # the OLD layout) — abort them; the driver's commit then fails
+        # loudly and no manifest ever references the torn files
+        if self._snapshots:
+            for sid in list(self._snapshots):
+                del self._snapshots[sid]
+                flightrec.record(
+                    "ckpt.abort", node=self.post.node_id, sid=sid,
+                    why="routing changed mid-snapshot",
+                )
         for t, tbl in self.tables.items():
             new_segs = new_routing.tables[t].owned_segments(self.server_index)
             old_segs = self.routing.tables[t].owned_segments(self.server_index)
@@ -1375,6 +1423,13 @@ class KVServer(Customer):
             return msg.reply()
         if op and op.startswith("migrate_"):
             return self._handle_migrate(msg)
+        if op and op.startswith("snap_"):
+            return self._handle_snapshot(msg)
+        if op == "restore_snap":
+            self.restore_snapshot(
+                msg.task.payload["root"], msg.task.payload["step"]
+            )
+            return msg.reply()
         raise ValueError(f"unsupported control op {op!r}")
 
     def save_checkpoint(self, root: str, step: int) -> None:
@@ -1396,11 +1451,12 @@ class KVServer(Customer):
             ]
             segs = self.routing.tables[t].owned_segments(self.server_index)
             if segs != [seg for seg in uniform if seg[1] > seg[0]]:
-                raise RuntimeError(
+                raise checkpoint.CheckpointLayoutError(
                     f"save_checkpoint: {self.post.node_id} owns migrated "
                     f"segments {segs} of {t!r} (uniform shard is {uniform}); "
-                    "the shard-file format is uniform-contiguous — drain the "
-                    "migration back or use replica-chain recovery"
+                    "the legacy shard-file format is uniform-contiguous — "
+                    "use the partitioned durability plane "
+                    "(KVWorker.save_snapshot) or drain the migration back"
                 )
             checkpoint.save_shard(
                 root,
@@ -1420,3 +1476,201 @@ class KVServer(Customer):
             checkpoint.restore_shard(
                 root, step, t, table, self.server_index, self.partitions[t].num_servers
             )
+
+    # -- durability plane (ISSUE 16): partitioned incremental snapshots ------
+    def _handle_snapshot(self, msg: Message) -> Message:
+        """Three-phase snapshot, same shape as live migration.
+
+        - ``snap_begin``  arms per-table dirty-row tracking (the
+          ``_ack_push`` hot path adds only host set updates: sync-free);
+        - ``snap_write``  bulk-exports ONE owned segment to its own file.
+          Runs serially on the recv thread, so pushes interleave *between*
+          segments — the table is never frozen for the bulk copy.  If the
+          segment's version clock has not advanced past the driver's
+          ``base_sver``, nothing is written and the driver carries the
+          base manifest entry forward (the incremental path);
+        - ``snap_commit`` is the only freeze: export the rows dirtied
+          since ``snap_begin`` as the delta log and stamp commit-time
+          segment versions.  Bounded by the dirty set exactly like
+          :meth:`_commit_migration`, measured and recorded;
+        - ``snap_abort``  drops the bookkeeping (files left behind are
+          garbage a manifest never references — retention sweeps them).
+        """
+        from parameter_server_tpu import checkpoint
+
+        p = msg.task.payload
+        op = p["op"]
+        if op == "snap_begin":
+            sid = str(p["sid"])
+            self._snapshots[sid] = {"dirty": {}}
+            flightrec.record("ckpt.begin", node=self.post.node_id, sid=sid)
+            return msg.reply()
+        if op == "snap_abort":
+            sn = self._snapshots.pop(str(p["sid"]), None)
+            if sn is not None:
+                flightrec.record(
+                    "ckpt.abort", node=self.post.node_id, sid=str(p["sid"]),
+                    why=str(p.get("why", "driver abort")),
+                )
+            return msg.reply()
+        sid = str(p["sid"])
+        if sid not in self._snapshots:
+            raise RuntimeError(
+                f"snapshot {sid!r} is not open on {self.post.node_id} "
+                "(aborted by a routing change?)"
+            )
+        if op == "snap_write":
+            t, lo, hi = p["table"], int(p["lo"]), int(p["hi"])
+            starts, ends, _ = self._shard_maps[t]
+            hit = np.nonzero((starts == lo) & (ends == hi))[0]
+            if hit.size != 1:
+                raise RuntimeError(
+                    f"snap_write: {self.post.node_id} does not own segment "
+                    f"{t}[{lo}:{hi}) as a whole"
+                )
+            cur = int(self._seg_versions[t][int(hit[0])])
+            base = p.get("base_sver")
+            reply = msg.reply()
+            if base is not None and int(base) == cur:
+                # version clock unchanged since the base snapshot: the
+                # driver re-uses the base file + CRC (ship only deltas)
+                reply.task = dataclasses.replace(
+                    msg.task,
+                    payload={"carried": True, "sver": cur, "table": t,
+                             "lo": lo, "hi": hi},
+                )
+                return reply
+            value, state = self.export_range(t, lo, hi)
+            entry = checkpoint.write_segment_file(
+                str(p["root"]), int(p["step"]), t, lo, hi, value, state
+            )
+            flightrec.record(
+                "ckpt.segment", node=self.post.node_id, sid=sid, table=t,
+                lo=lo, hi=hi, bytes=entry["bytes"],
+            )
+            reply.task = dataclasses.replace(
+                msg.task,
+                payload={"carried": False, "sver": cur, "table": t,
+                         "lo": lo, "hi": hi, "entry": entry},
+            )
+            return reply
+        if op == "snap_commit":
+            sn = self._snapshots.pop(sid)
+            t0 = time.perf_counter()
+            root, step = str(p["root"]), int(p["step"])
+            deltas: List[dict] = []
+            n_dirty = 0
+            for t in sorted(sn["dirty"]):
+                gids = np.asarray(sorted(sn["dirty"][t]), dtype=np.int64)
+                if not gids.size:
+                    continue
+                value, state = self._export_rows(t, gids)
+                entry = checkpoint.write_delta_file(
+                    root, step, t, self.server_index, gids, value, state
+                )
+                if entry is not None:
+                    deltas.append(entry)
+                    n_dirty += int(gids.size)
+            svers = [
+                [t, int(s), int(e), int(v)]
+                for t in sorted(self.tables)
+                for s, e, v in zip(
+                    self._shard_maps[t][0], self._shard_maps[t][1],
+                    self._seg_versions[t],
+                )
+            ]
+            freeze = time.perf_counter() - t0
+            self.ckpt_freeze_last_s = freeze
+            self.ckpt_freeze_s += freeze
+            self.ckpt_commits += 1
+            self.ckpt_delta_rows += n_dirty
+            over = n_dirty > self.ckpt_max_delta_rows
+            if over:
+                # soft bound: the snapshot still commits, but the breach
+                # is visible (counter + event) so the interval can be
+                # tightened before the freeze grows further
+                self.ckpt_delta_overflow += 1
+            self._ckpt_commit_t = time.monotonic()
+            flightrec.record(
+                "ckpt.commit", node=self.post.node_id, sid=sid, step=step,
+                dirty=n_dirty, freeze_ms=round(1e3 * freeze, 3),
+                over_bound=over,
+            )
+            reply = msg.reply()
+            reply.task = dataclasses.replace(
+                msg.task,
+                payload={"deltas": deltas, "svers": svers,
+                         "freeze_s": freeze},
+            )
+            return reply
+        raise ValueError(f"unsupported snapshot op {op!r}")
+
+    def restore_snapshot(
+        self, root: str, step: int, *, adopt_routing: bool = False
+    ) -> None:
+        """Point-in-time restore from a partitioned snapshot.
+
+        Reads only the manifest plus the file ranges covering the segments
+        THIS server owns under its CURRENT routing table — the snapshot may
+        have been written by a fleet of any shape (the reshard happens row-
+        wise in :func:`checkpoint.snapshot_rows`).  Re-seeds the per-segment
+        version clock from the manifest so the staleness plane stays
+        monotonic across the restore.
+
+        ``adopt_routing``: first adopt the manifest's routing table when it
+        is NEWER than this server's — the same-id-restart path, where a
+        freshly constructed server starts at the uniform epoch 0 but the
+        snapshot was written by a fleet that had since migrated; without
+        the adoption the restarted server would not own its migrated
+        segments and every worker leg into them would fence forever.
+        Fleet-shape restores (``load_snapshot``) keep it off: there the
+        CURRENT fleet's routing is authoritative, not the writer's.
+        """
+        from parameter_server_tpu import checkpoint
+
+        manifest = checkpoint.read_snapshot(root, step)
+        if adopt_routing:
+            snap_routing = RoutingTable.from_payload(manifest["routing"])
+            if snap_routing.epoch > self.routing.epoch:
+                # metadata-only adoption — no content hand-off like
+                # ``_install_routing`` does for migrations, because every
+                # owned row is about to be overwritten from the snapshot
+                # (``install_rows`` below re-sizes the shard storage)
+                self.routing = snap_routing
+                self._shard_maps = {
+                    t: self._make_map(snap_routing, t) for t in self.tables
+                }
+                self._seg_versions = {
+                    t: np.zeros(
+                        self._shard_maps[t][0].shape[0], dtype=np.int64
+                    )
+                    for t in self.tables
+                }
+        by_seg: Dict[Tuple[str, int, int], int] = {}
+        for e in manifest["segments"]:
+            key = (str(e["table"]), int(e["lo"]), int(e["hi"]))
+            by_seg[key] = max(by_seg.get(key, 0), int(e.get("sver", 0)))
+        for t, table in self.tables.items():
+            segs = self.routing.tables[t].owned_segments(self.server_index)
+            checkpoint.restore_segments(root, manifest, t, segs, table)
+            ver = self._seg_versions[t]
+            starts, ends, _ = self._shard_maps[t]
+            for i in range(starts.shape[0]):
+                lo, hi = int(starts[i]), int(ends[i])
+                # exact match first; else the max over overlapping source
+                # segments (restore onto a different fleet shape)
+                v = by_seg.get((t, lo, hi))
+                if v is None:
+                    v = max(
+                        (
+                            sv for (tt, sl, sh), sv in by_seg.items()
+                            if tt == t and sl < hi and sh > lo
+                        ),
+                        default=0,
+                    )
+                ver[i] = max(int(ver[i]), v)
+        self._ckpt_commit_t = time.monotonic()
+        flightrec.record(
+            "ckpt.restore", node=self.post.node_id, step=int(step),
+            tables=len(self.tables),
+        )

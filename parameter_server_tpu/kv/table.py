@@ -197,6 +197,33 @@ class KVTable:
             )
         self.value = jnp.asarray(value, dtype=self.value.dtype)
 
+    def install_rows(
+        self, value: np.ndarray, state: Dict[str, np.ndarray]
+    ) -> None:
+        """Replace the shard with ``[rows, dim]`` host arrays (NO trash row).
+
+        The restore-side counterpart of the checkpoint writers (which save
+        rows excluding the trash row): appends a fresh trash row — zero
+        value, optimizer init fills — and installs via :meth:`resize`, so
+        the shard may change row count (restore onto a different fleet
+        shape).
+        """
+        if set(state) != set(self.state):
+            raise ValueError(
+                f"optimizer state keys mismatch: {set(state)} != {set(self.state)}"
+            )
+        n = int(value.shape[0])
+        dtype = np.asarray(self.value).dtype
+        fills = self.optimizer.state_shapes()
+        buf = np.zeros((n + 1, self.dim), dtype)
+        buf[:n] = value
+        sbuf = {}
+        for k, fill in fills.items():
+            sk = np.full((n + 1, self.dim), fill, dtype)
+            sk[:n] = state[k]
+            sbuf[k] = sk
+        self.resize(buf, sbuf)
+
     def resize(self, value: np.ndarray, state: Dict[str, np.ndarray]) -> None:
         """Replace the shard wholesale with a DIFFERENT row count.
 

@@ -1530,3 +1530,208 @@ class KVWorker(Customer):
             for s in self.routing.servers()
         ]
         return self.submit(msgs, keep_responses=True)
+
+    def _control_round(
+        self, msgs: List[Message], what: str, timeout: Optional[float]
+    ) -> List[Message]:
+        """Submit control messages, wait, raise on any error, return replies."""
+        ts = self.submit(msgs, keep_responses=True)
+        if not self.wait(ts, timeout):
+            raise TimeoutError(f"{what} timed out")
+        self.check(ts)
+        return self.take_responses(ts)
+
+    # -- durability plane (ISSUE 16): partitioned incremental snapshots ------
+    def save_snapshot(
+        self,
+        root: str,
+        step: int,
+        *,
+        base_step: Optional[int] = None,
+        clocks: Optional[list] = None,
+        extras: Optional[dict] = None,
+        timeout: Optional[float] = 600.0,
+    ) -> dict:
+        """Partitioned, incremental, non-blocking snapshot of every table.
+
+        Unlike :meth:`save_model` this works for ANY routing layout: each
+        owning server writes one file per owned segment, and the driver
+        (here) assembles + CRC-verifies the manifest.  With ``base_step``
+        set, segments whose version clock has not advanced are NOT
+        rewritten — the base snapshot's file is carried forward by
+        reference and only the dirty-row delta logs ship (the PR-10
+        ``__sver__`` clock as LSN).  Pushes keep applying throughout; the
+        only freeze is each server's delta export at ``snap_commit``.
+
+        Returns a summary: carried/written segment counts, total delta
+        rows, and per-server commit-freeze seconds.
+        """
+        from parameter_server_tpu import checkpoint
+        from parameter_server_tpu.utils.keys import localizer_meta
+
+        base = None
+        if base_step is not None:
+            base = checkpoint.read_snapshot(root, base_step)
+        base_entries = {
+            (e["table"], int(e["lo"]), int(e["hi"])): e
+            for e in (base["segments"] if base else [])
+        }
+        sid = f"ckpt-{int(step)}-e{self.routing.epoch}"
+        begun = False
+        try:
+            self._control_round(
+                [
+                    Message(
+                        task=Task(TaskKind.CONTROL, self.name,
+                                  payload={"op": "snap_begin", "sid": sid}),
+                        recver=server_id(s),
+                    )
+                    for s in self.routing.servers()
+                ],
+                "snap_begin", timeout,
+            )
+            begun = True
+            # one snap_write per segment, addressed to its owner; servers
+            # process them serially on the recv thread, so pushes
+            # interleave between segments — no bulk-copy freeze
+            writes = []
+            for t in sorted(self.routing.tables):
+                for lo, hi, owner in self.routing.tables[t].segments():
+                    payload = {
+                        "op": "snap_write", "sid": sid, "root": root,
+                        "step": int(step), "table": t, "lo": lo, "hi": hi,
+                    }
+                    be = base_entries.get((t, lo, hi))
+                    if be is not None:
+                        payload["base_sver"] = int(be.get("sver", 0))
+                    writes.append(
+                        Message(
+                            task=Task(TaskKind.CONTROL, self.name,
+                                      payload=payload),
+                            recver=server_id(owner),
+                        )
+                    )
+            # a migrated owner holds several segments; the Customer dedups
+            # responses per (ts, sender), so each round may address any
+            # server at most once — round-robin the writes into such rounds
+            rounds: List[List[Message]] = []
+            for m in writes:
+                for batch in rounds:
+                    if all(b.recver != m.recver for b in batch):
+                        batch.append(m)
+                        break
+                else:
+                    rounds.append([m])
+            entries: List[dict] = []
+            carried_tables: set = set()
+            n_carried = 0
+            for batch in rounds:
+                for r in self._control_round(batch, "snap_write", timeout):
+                    pl = r.task.payload
+                    key = (str(pl["table"]), int(pl["lo"]), int(pl["hi"]))
+                    if pl.get("carried"):
+                        entries.append(dict(base_entries[key]))
+                        carried_tables.add(key[0])
+                        n_carried += 1
+                    else:
+                        entries.append(dict(pl["entry"]))
+            # commit: the measured, delta-bounded freeze on every server
+            deltas: List[dict] = []
+            svers: Dict[tuple, int] = {}
+            freezes: List[float] = []
+            delta_rows = 0
+            for r in self._control_round(
+                [
+                    Message(
+                        task=Task(
+                            TaskKind.CONTROL, self.name,
+                            payload={"op": "snap_commit", "sid": sid,
+                                     "root": root, "step": int(step)},
+                        ),
+                        recver=server_id(s),
+                    )
+                    for s in self.routing.servers()
+                ],
+                "snap_commit", timeout,
+            ):
+                pl = r.task.payload
+                for d in pl["deltas"]:
+                    deltas.append(dict(d))
+                    delta_rows += int(d["rows"])
+                for t, lo, hi, v in pl["svers"]:
+                    svers[(str(t), int(lo), int(hi))] = int(v)
+                freezes.append(float(pl["freeze_s"]))
+        except Exception:
+            if begun:
+                # best-effort: release server-side dirty tracking; orphan
+                # segment files are swept by retention, and with no
+                # manifest the step simply never exists
+                try:
+                    msgs = [
+                        Message(
+                            task=Task(
+                                TaskKind.CONTROL, self.name,
+                                payload={"op": "snap_abort", "sid": sid,
+                                         "why": "driver error"},
+                            ),
+                            recver=server_id(s),
+                        )
+                        for s in self.routing.servers()
+                    ]
+                    self._control_round(msgs, "snap_abort", timeout)
+                except Exception:
+                    pass
+            raise
+        # stamp commit-time segment versions: a row pushed between a
+        # segment's write and the commit is in this snapshot's delta log,
+        # so the NEXT snapshot may carry the file at the commit-time clock
+        for e in entries:
+            key = (e["table"], int(e["lo"]), int(e["hi"]))
+            if key in svers:
+                e["sver"] = svers[key]
+        # incremental chains stay flat: carry the base's deltas only for
+        # tables that carried at least one base file (fresh files are
+        # stamped with THIS step, so older deltas can never apply to them)
+        if base is not None:
+            for d in base["deltas"]:
+                if d["table"] in carried_tables:
+                    deltas.append(dict(d))
+        extras = dict(extras or {})
+        extras.setdefault(
+            "localizers",
+            {t: localizer_meta(loc) for t, loc in self.localizers.items()},
+        )
+        checkpoint.finalize_snapshot(
+            root, step, self.routing.to_payload(), entries, deltas,
+            base_step=base_step, clocks=clocks, extras=extras,
+        )
+        return {
+            "step": int(step),
+            "segments": len(entries),
+            "carried": n_carried,
+            "delta_rows": delta_rows,
+            "freeze_s": freezes,
+        }
+
+    def load_snapshot(
+        self, root: str, step: int, *, timeout: Optional[float] = 600.0
+    ) -> None:
+        """Broadcast restore-from-partitioned-snapshot to the current fleet.
+
+        The fleet shape may differ from the writing fleet's: each server
+        reads only the manifest file ranges covering its CURRENT segments.
+        """
+        self._control_round(
+            [
+                Message(
+                    task=Task(
+                        TaskKind.CONTROL, self.name,
+                        payload={"op": "restore_snap", "root": root,
+                                 "step": int(step)},
+                    ),
+                    recver=server_id(s),
+                )
+                for s in self.routing.servers()
+            ],
+            "restore_snap", timeout,
+        )

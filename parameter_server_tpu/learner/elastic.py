@@ -45,7 +45,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 import jax.numpy as jnp
 import numpy as np
 
-from parameter_server_tpu.config import ConsistencyConfig
+from parameter_server_tpu.config import CheckpointConfig, ConsistencyConfig
 from parameter_server_tpu.core.clock import ConsistencyController
 from parameter_server_tpu.core.manager import Manager
 from parameter_server_tpu.kv.worker import KVWorker
@@ -80,6 +80,7 @@ class ElasticTrainer:
         heartbeat_interval: float = 0.5,
         ckpt_root: Optional[str] = None,
         ckpt_every: int = 0,
+        ckpt_config: Optional[CheckpointConfig] = None,
         timeout: float = 60.0,
     ) -> None:
         self.workers = workers
@@ -94,6 +95,7 @@ class ElasticTrainer:
         self._index = {wid: i for i, wid in enumerate(sorted(workers))}
         self.ckpt_root = ckpt_root
         self.ckpt_every = ckpt_every
+        self.ckpt_config = ckpt_config or CheckpointConfig()
         self.timeout = timeout
         self._ckpt_lock = threading.Lock()
         self._ckpt_pending = 0
@@ -252,6 +254,33 @@ class ElasticTrainer:
             if self.pool.finish(wid, wl.workload_id):
                 self._maybe_checkpoint(kv)
 
+    def _use_partitioned(self, kv: KVWorker) -> bool:
+        """Pick the checkpoint plane per ``ckpt_config.mode``.
+
+        ``auto`` decides client-side (a server's typed
+        ``CheckpointLayoutError`` does not survive the wire): the
+        partitioned durability plane whenever a snapshot chain already
+        exists (keep extending it incrementally) or the routing layout has
+        drifted from the uniform split the legacy shard-file format
+        requires; the legacy format otherwise, for compatibility with
+        pre-format-2 readers.
+        """
+        mode = self.ckpt_config.mode
+        if mode != "auto":
+            return mode == "partitioned"
+        from parameter_server_tpu import checkpoint
+        from parameter_server_tpu.kv.routing import TableRouting
+
+        if checkpoint.latest_snapshot(self.ckpt_root) is not None:
+            return True
+        for tr in kv.routing.tables.values():
+            u = TableRouting.uniform(tr.rows, kv.num_servers)
+            if (tuple(tr.offsets), tuple(tr.owners)) != (
+                tuple(u.offsets), tuple(u.owners)
+            ):
+                return True
+        return False
+
     def _maybe_checkpoint(self, kv: KVWorker) -> None:
         if not self.ckpt_root or self.ckpt_every <= 0:
             return
@@ -264,17 +293,36 @@ class ElasticTrainer:
             self._ckpt_pending = 0
             self._ckpt_running = True
         step = self.pool.num_done()
+        if step == self.last_ckpt_step:
+            with self._ckpt_lock:
+                self._ckpt_running = False
+            return
+        from parameter_server_tpu import checkpoint
+
         try:
-            kv.save_model(
-                self.ckpt_root,
-                step,
-                clocks=self.controller.clock.snapshot(),
-                timeout=self.timeout,
-            )
+            clocks = self.controller.clock.snapshot()
+            if self._use_partitioned(kv):
+                kv.save_snapshot(
+                    self.ckpt_root,
+                    step,
+                    base_step=checkpoint.latest_snapshot(self.ckpt_root),
+                    clocks=clocks,
+                    timeout=self.timeout,
+                )
+                if self.ckpt_config.retention > 0:
+                    checkpoint.retain_snapshots(
+                        self.ckpt_root, self.ckpt_config.retention
+                    )
+            else:
+                kv.save_model(
+                    self.ckpt_root, step, clocks=clocks, timeout=self.timeout
+                )
             self.last_ckpt_step = step
-        except (TimeoutError, RuntimeError) as e:
+        except (TimeoutError, RuntimeError, OSError) as e:
             # checkpoint failure must not kill training (a dead server
-            # mid-save is exactly the scenario recovery handles)
+            # mid-save is exactly the scenario recovery handles); an
+            # aborted snapshot leaves no manifest, so the previous one
+            # stays the restore point
             log.warning("checkpoint at %s failed: %s", step, e)
         finally:
             with self._ckpt_lock:

@@ -430,6 +430,33 @@ def compression_plane_specs(
     ]
 
 
+def durability_plane_specs(
+    *,
+    max_age_s: float = 120.0,
+    window_s: float = 10.0,
+) -> List[SloSpec]:
+    """The ISSUE-16 durability-plane SLO.
+
+    ``ckpt-age`` watches the server's ``ckpt_age_s`` gauge — seconds since
+    the shard last committed to (or restored from) a durable snapshot.  The
+    gauge's basis is stamped at server construction, so a fleet that NEVER
+    snapshots breaches once ``max_age_s`` elapses: silence is a failure
+    mode here, not a healthy default.  Breaching bounds the restore rewind
+    (work since the last snapshot) — tighten the checkpoint interval or
+    investigate why commits stopped (driver wedged, disk full, snapshots
+    aborted by a routing churn loop).
+    """
+    return [
+        SloSpec(
+            "ckpt-age",
+            "ckpt_age_s",
+            max_age_s,
+            source="gauge",
+            window_s=window_s,
+        ),
+    ]
+
+
 def _delta_hist(first: dict, last: dict) -> LatencyHistogram:
     """Histogram of the samples recorded BETWEEN two cumulative digests.
 

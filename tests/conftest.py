@@ -48,13 +48,19 @@ def pytest_runtest_makereport(item, call):
     ``item.callspec``; tests with hardcoded seeds can instead stash one via
     ``item.user_properties.append(("chaos_seed", seed))``.  Migration /
     rebalance tests (PR 6) get the same one-line repro contract — their
-    kill-mid-stream and skew scenarios are seed-driven the same way.
+    kill-mid-stream and skew scenarios are seed-driven the same way, as do
+    the durability-plane ``checkpoint`` drills (PR 16: kill-mid-snapshot,
+    torn-file, reshard-restore).
     """
     outcome = yield
     report = outcome.get_result()
     if report.when != "call" or not report.failed:
         return
-    if "chaos" not in item.keywords and "migration" not in item.keywords:
+    if (
+        "chaos" not in item.keywords
+        and "migration" not in item.keywords
+        and "checkpoint" not in item.keywords
+    ):
         return
     seeds = {}
     params = getattr(item, "callspec", None)

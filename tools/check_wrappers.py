@@ -174,6 +174,14 @@ REQUIRED_EVENTS = frozenset({
     "group.reduce",
     "group.elect",
     "group.fallback",
+    # durability plane (ISSUE 16): the partitioned-snapshot lifecycle —
+    # dropping any of these would silence the checkpoint plane (and lose
+    # the interrupted-snapshot anomaly anchor, ckpt.abort)
+    "ckpt.begin",
+    "ckpt.segment",
+    "ckpt.commit",
+    "ckpt.restore",
+    "ckpt.abort",
 })
 
 #: ``np.<attr>`` calls that materialize a device array on the host.

@@ -56,6 +56,7 @@ ANOMALY_KINDS = frozenset({
     "apply.backlog",
     "serve.shed",
     "group.fallback",
+    "ckpt.abort",
 })
 
 

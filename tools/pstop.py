@@ -41,6 +41,10 @@ Columns:
                 group-stamped push arrives;
 - ``SHED/S``    reads shed by admission control per second (serving
                 workers; the ``serve.shed`` event rate);
+- ``CKPT``      seconds since the node's shard last committed to (or
+                restored from) a durable snapshot — the durability
+                plane's ``ckpt_age_s`` gauge (servers only; ``-`` for
+                nodes that never snapshot);
 - ``DRP``       cumulative telemetry frames the aggregator dropped for
                 this node (duplicates/stale seq — control-plane health);
 - ``MIG``       active migrations (begin - commit - abort event totals);
@@ -72,7 +76,7 @@ _HEADER = (
     f"{'NODE':<10} {'SEQ':>5} {'AGE':>6} {'MSG/S':>8} {'KB/S':>9} "
     f"{'P99ms':>8} {'STALE p50/p99':>14} {'INF':>4} {'BKLG':>6} "
     f"{'APLYms':>7} {'RO/S':>7} {'HIT%':>5} {'CMPR%':>6} {'GRP%':>6} "
-    f"{'SHED/S':>7} "
+    f"{'SHED/S':>7} {'CKPT':>6} "
     f"{'DRP':>4} {'MIG':>3} {'SLO':<18} FLAGS"
 )
 
@@ -213,6 +217,11 @@ def render(latest: Dict[str, dict], now: Optional[float] = None) -> List[str]:
         # member pushes they carry (lifetime-cumulative, servers only)
         grp = row.get("grp_pct")
         shed_s = row.get("shed_per_s")
+        # durability plane: seconds since the shard's last snapshot commit
+        # (the ckpt_age_s gauge, surfaced by the aggregator like ro_per_s)
+        ckpt = row.get("ckpt_age_s")
+        if ckpt is None:
+            ckpt = counters.get("ckpt_age_s")
         drops = (row.get("ctl") or {}).get("drops")
         healthy = row.get("healthy")
         if healthy is None:
@@ -237,6 +246,7 @@ def render(latest: Dict[str, dict], now: Optional[float] = None) -> List[str]:
             f"{f'{cmpr:.1f}' if cmpr is not None else '-':>6} "
             f"{f'{grp:.1f}' if grp is not None else '-':>6} "
             f"{f'{shed_s:.1f}' if shed_s is not None else '-':>7} "
+            f"{f'{float(ckpt):.1f}' if ckpt is not None else '-':>6} "
             f"{int(drops) if drops is not None else '-':>4} "
             f"{mig:>3} {slo:<18} {flags}"
         )
