@@ -1302,7 +1302,9 @@ def _emb_plane_overlapped(
         g = rows.reshape(-1, D) * 0.01
         worker.wait(worker.push_device("emb", toks[0].reshape(-1), g), 120)
 
-        sent0, recv0 = van_w.bytes_sent(), van_w.bytes_recv()
+        # payload (socket + shm-ring) bytes: colocated vans ride the shm
+        # fast path, so socket-only counters would read ~0 here
+        sent0, recv0 = van_w.payload_bytes_sent(), van_w.payload_bytes_recv()
         exposures = []
         ts_cur = worker.pull("emb", toks[1])
         pts_prev = None
@@ -1327,7 +1329,8 @@ def _emb_plane_overlapped(
             worker.wait(pts_prev, 120)
         wall = time.perf_counter() - t_all
         wire_mb = (
-            (van_w.bytes_sent() - sent0 + van_w.bytes_recv() - recv0)
+            (van_w.payload_bytes_sent() - sent0
+             + van_w.payload_bytes_recv() - recv0)
             / steps / 1e6
         )
         uniq = float(np.mean([len(np.unique(t)) for t in toks[1:-1]]))
@@ -4244,6 +4247,350 @@ def record_anchor(record: dict, diag: str) -> None:
     )
 
 
+# -- Transport v2: shm fast path + epoll fan-in (ISSUE 17) -----------------
+
+_TRANSPORT_BEGIN = "<!-- BENCH-TRANSPORT:BEGIN -->"
+_TRANSPORT_END = "<!-- BENCH-TRANSPORT:END -->"
+
+#: the BASELINE.md serving-table cache-hit p50 the shm ring must undercut
+#: (ISSUE 17 acceptance: "well under 62.95 us").
+_TRANSPORT_RTT_TARGET_US = 62.95
+_TRANSPORT_RING_REPS = 2000
+_TRANSPORT_VAN_REPS = 300
+_TRANSPORT_FANIN_CONNS = (64, 512, 4096)
+_TRANSPORT_FANIN_MSGS = 4000
+
+_TRANSPORT_FANIN_CHILD = r"""
+import socket, struct, sys, time
+sys.path.insert(0, {repo!r})
+from parameter_server_tpu.core.messages import Message, Task, TaskKind
+from parameter_server_tpu.core.tcp_van import serialize_message
+
+host, port = {host!r}, {port}
+phases = {phases!r}
+MAGIC = 0x50535641
+
+socks = []
+
+
+def grow_to(n):
+    while len(socks) < n:
+        for _ in range(min(200, n - len(socks))):
+            for _attempt in range(50):
+                try:
+                    s = socket.create_connection((host, port), timeout=10)
+                    break
+                except OSError:
+                    time.sleep(0.05)
+            else:
+                raise SystemExit("connect storm exhausted retries")
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            socks.append(s)
+        time.sleep(0.01)
+
+
+def frame_bytes(phase):
+    m = Message(
+        task=Task(TaskKind.CONTROL, "fanin", payload={{"p": phase}}),
+        sender="", recver="FANIN",
+    )
+    buf = serialize_message(m)
+    return struct.pack("<IQ", MAGIC, len(buf)) + bytes(buf)
+
+
+for pi, (n_conns, n_msgs) in enumerate(phases):
+    grow_to(n_conns)
+    wire = frame_bytes(pi)
+    for i in range(n_msgs):
+        socks[(i * 7919) % len(socks)].sendall(wire)
+time.sleep(1.0)
+"""
+
+
+def _transport_messages():
+    """A serving-sized request/reply pair (128 keys, dim-1 fp32 rows) —
+    the shape behind the 62.95 us cache-hit p50 this arm must undercut."""
+    from parameter_server_tpu.core.messages import Message, Task, TaskKind
+
+    req = Message(
+        task=Task(TaskKind.PULL, "w", time=1),
+        sender="W0", recver="S0",
+        keys=np.arange(128, dtype=np.uint64),
+    )
+    rsp = Message(
+        task=Task(TaskKind.PULL, "w", time=1),
+        sender="S0", recver="W0",
+        keys=np.arange(128, dtype=np.uint64),
+        values=[np.zeros(128, np.float32)],
+        is_request=False,
+    )
+    return req, rsp
+
+
+def _transport_ring_rtt() -> dict:
+    """Request/reply through a pair of shm rings, single-threaded (writer
+    and reader roles played back-to-back): the per-message transport cost
+    with zero scheduler noise.  A threaded ping-pong on a 1-core host
+    measures the GIL's sleep granularity, not the ring.
+
+    Two series: ``transit`` = pre-encoded wire segments in, raw record
+    view out, both directions — the RTT of the ring itself, i.e. exactly
+    what the shm path replaces (syscalls + kernel socket copies);
+    ``codec`` adds the full flat-frame encode/decode both ways (that cost
+    is paid identically on every transport, TCP included)."""
+    from parameter_server_tpu.core import frame
+    from parameter_server_tpu.core.shm_ring import ShmRing
+
+    req_msg, rsp_msg = _transport_messages()
+    req_tx = ShmRing.create()
+    rsp_tx = ShmRing.create()
+    req_rx = ShmRing.attach(req_tx.path)
+    rsp_rx = ShmRing.attach(rsp_tx.path)
+    transit, codec = [], []
+    try:
+        req_segs, req_total = frame.encode_vec(req_msg)
+        rsp_segs, rsp_total = frame.encode_vec(rsp_msg)
+        for i in range(_TRANSPORT_RING_REPS + 200):
+            t0 = time.perf_counter()
+            assert req_tx.write(req_segs, req_total, timeout=1.0)
+            idx, _view = req_rx.read()
+            req_rx.release(idx)
+            assert rsp_tx.write(rsp_segs, rsp_total, timeout=1.0)
+            idx, _view = rsp_rx.read()
+            rsp_rx.release(idx)
+            if i >= 200:
+                transit.append((time.perf_counter() - t0) * 1e6)
+        for i in range(_TRANSPORT_RING_REPS + 200):
+            t0 = time.perf_counter()
+            segs, total = frame.encode_vec(req_msg)
+            assert req_tx.write(segs, total, timeout=1.0)
+            idx, view = req_rx.read()
+            m = frame.decode(view)
+            del m, view
+            req_rx.release(idx)
+            segs, total = frame.encode_vec(rsp_msg)
+            assert rsp_tx.write(segs, total, timeout=1.0)
+            idx, view = rsp_rx.read()
+            m = frame.decode(view)
+            del m, view
+            rsp_rx.release(idx)
+            if i >= 200:
+                codec.append((time.perf_counter() - t0) * 1e6)
+    finally:
+        for r in (req_rx, rsp_rx, req_tx, rsp_tx):
+            r.close()
+    return {
+        "transit_p50_us": round(float(np.percentile(transit, 50)), 2),
+        "transit_p99_us": round(float(np.percentile(transit, 99)), 2),
+        "codec_p50_us": round(float(np.percentile(codec, 50)), 2),
+        "codec_p99_us": round(float(np.percentile(codec, 99)), 2),
+    }
+
+
+def _transport_van_rtt(transport) -> dict:
+    """Full-stack RTT through two in-process TcpVans: send -> dispatch ->
+    endpoint handler -> reply over the peer conn.  Includes every queue
+    and thread wakeup, so arms are comparable to EACH OTHER (same host,
+    same stack depth), not to the bare-ring number."""
+    import threading
+
+    from parameter_server_tpu.core.tcp_van import TcpVan
+
+    req_msg, rsp_msg = _transport_messages()
+    a, b = TcpVan(transport=transport), TcpVan(transport=transport)
+    try:
+        ev = threading.Event()
+        b.bind("S0", lambda m: b.send(rsp_msg))
+        a.bind("W0", lambda m: ev.set())
+        a.add_route("S0", b.address)
+        deadline = time.time() + 10
+        while transport.shm and time.time() < deadline:
+            if a.counters()["shm_links"] == 1:
+                break
+            ev.clear()
+            a.send(req_msg)
+            ev.wait(1)
+            time.sleep(0.01)
+        samples = []
+        for i in range(_TRANSPORT_VAN_REPS + 30):
+            ev.clear()
+            t0 = time.perf_counter()
+            assert a.send(req_msg)
+            assert ev.wait(10)
+            if i >= 30:
+                samples.append((time.perf_counter() - t0) * 1e6)
+        used_shm = a.counters()["shm_frames_sent"] > 0
+    finally:
+        a.close()
+        b.close()
+    return {
+        "p50_us": round(float(np.percentile(samples, 50)), 2),
+        "p99_us": round(float(np.percentile(samples, 99)), 2),
+        "rode_shm": bool(used_shm),
+    }
+
+
+def _transport_fanin() -> list[dict]:
+    """Inbound fan-in on the epoll backend: deliver rate at the server as
+    the live connection count grows (raw-socket clients in a subprocess —
+    the parent's fd table holds only the accepted side)."""
+    import subprocess
+    import threading
+
+    from parameter_server_tpu.config import TransportConfig
+    from parameter_server_tpu.core.tcp_van import TcpVan
+
+    phases = [(n, _TRANSPORT_FANIN_MSGS) for n in _TRANSPORT_FANIN_CONNS]
+    van = TcpVan(transport=TransportConfig(wire="epoll"))
+    stamps = [[] for _ in phases]
+    lock = threading.Lock()
+
+    def handler(msg):
+        now = time.perf_counter()
+        with lock:
+            stamps[msg.task.payload["p"]].append(now)
+
+    van.bind("FANIN", handler)
+    child = None
+    try:
+        script = _TRANSPORT_FANIN_CHILD.format(
+            repo=os.path.dirname(os.path.abspath(__file__)),
+            host="127.0.0.1", port=van.port, phases=phases,
+        )
+        child = subprocess.Popen(
+            [sys.executable, "-c", script],
+            stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, text=True,
+        )
+        out = []
+        for pi, (n_conns, n_msgs) in enumerate(phases):
+            deadline = time.time() + 240
+            while time.time() < deadline:
+                with lock:
+                    got = len(stamps[pi])
+                if got >= n_msgs or child.poll() is not None:
+                    break
+                time.sleep(0.05)
+            if child.poll() is not None and len(stamps[pi]) < n_msgs:
+                _o, err = child.communicate(timeout=10)
+                raise RuntimeError(f"fan-in child died: {err[-500:]}")
+            span = stamps[pi][-1] - stamps[pi][0]
+            out.append({
+                "conns": n_conns,
+                "msgs_per_s": round((n_msgs - 1) / span, 0) if span else None,
+            })
+        child.wait(timeout=60)
+        return out
+    finally:
+        if child is not None and child.poll() is None:
+            child.kill()
+        van.close()
+
+
+def run_transport() -> tuple[dict, list[str]]:
+    """ISSUE 17 acceptance arm: intra-host RTT (shm ring vs full-stack
+    shm/TCP vans) and epoll fan-in deliver rate vs connection count.
+    Host-only: no TPU probe, no jax on the hot path."""
+    from parameter_server_tpu.config import TransportConfig
+
+    ring = _transport_ring_rtt()
+    van_shm = _transport_van_rtt(TransportConfig(wire="epoll", shm=True))
+    van_tcp = _transport_van_rtt(TransportConfig(wire="epoll", shm=False))
+    van_thr = _transport_van_rtt(TransportConfig(wire="threaded", shm=False))
+    fanin = _transport_fanin()
+
+    flat = None
+    if len(fanin) >= 2 and fanin[0]["msgs_per_s"] and fanin[-1]["msgs_per_s"]:
+        flat = round(fanin[-1]["msgs_per_s"] / fanin[0]["msgs_per_s"], 3)
+    # acceptance gates on the TRANSPORT's own RTT: the 62.95 us serving p50
+    # was measured over LoopbackVan (zero codec), so the comparable number
+    # is what the ring adds per round trip.  The codec series is reported
+    # for transparency but paid identically on every transport.
+    passed = (
+        ring["transit_p50_us"] < _TRANSPORT_RTT_TARGET_US / 2
+        and (flat is None or flat >= 0.8)
+    )
+    lines = [
+        f"transport: shm ring RTT p50 {ring['transit_p50_us']}us transit / "
+        f"{ring['codec_p50_us']}us with full codec "
+        f"(target << {_TRANSPORT_RTT_TARGET_US}us)",
+        f"van RTT p50: shm {van_shm['p50_us']}us (rode_shm="
+        f"{van_shm['rode_shm']}) vs tcp-epoll {van_tcp['p50_us']}us vs "
+        f"tcp-threaded {van_thr['p50_us']}us",
+        "fan-in: " + ", ".join(
+            f"{r['conns']}conn={r['msgs_per_s']:.0f}msg/s" for r in fanin
+        ) + (f" (retention {flat}x)" if flat else ""),
+        f"verdict: {'PASS' if passed else 'FAIL'}",
+    ]
+    record = {
+        "metric": "transport_shm_rtt_p50_us",
+        "value": ring["transit_p50_us"],
+        "unit": "us",
+        "vs_baseline": _TRANSPORT_RTT_TARGET_US,
+        "pass": passed,
+        "ring_rtt": ring,
+        "van_rtt": {
+            "shm": van_shm, "tcp_epoll": van_tcp, "tcp_threaded": van_thr,
+        },
+        "fanin": fanin,
+        "fanin_retention": flat,
+    }
+    return record, lines
+
+
+def record_transport(record: dict, lines: list[str]) -> None:
+    stamp = time.strftime("%Y-%m-%d %H:%M:%S UTC", time.gmtime())
+    vr = record["van_rtt"]
+    rtt_rows = (
+        f"| shm ring transit (wire segments in, record view out) | "
+        f"{record['ring_rtt']['transit_p50_us']} | "
+        f"{record['ring_rtt']['transit_p99_us']} |\n"
+        f"| shm ring + full frame codec both ways | "
+        f"{record['ring_rtt']['codec_p50_us']} | "
+        f"{record['ring_rtt']['codec_p99_us']} |\n"
+        f"| van stack, shm | {vr['shm']['p50_us']} | "
+        f"{vr['shm']['p99_us']} |\n"
+        f"| van stack, TCP epoll | {vr['tcp_epoll']['p50_us']} | "
+        f"{vr['tcp_epoll']['p99_us']} |\n"
+        f"| van stack, TCP threaded | {vr['tcp_threaded']['p50_us']} | "
+        f"{vr['tcp_threaded']['p99_us']} |\n"
+    )
+    fan_rows = "".join(
+        f"| {r['conns']} | {r['msgs_per_s']:.0f} |\n"
+        for r in record["fanin"]
+    )
+    body = (
+        f"\n{stamp}; serving-sized pull/reply (128 keys, dim-1 fp32), "
+        "host CPU only (1-core container: full-stack arms include "
+        "scheduler wakeups and compare to each other, not the ring row).\n\n"
+        "| intra-host request RTT | p50 us | p99 us |\n|---|---|---|\n"
+        + rtt_rows +
+        f"\nShm ring RTT p50 **{record['ring_rtt']['transit_p50_us']} us** "
+        f"transit / **{record['ring_rtt']['codec_p50_us']} us** with the "
+        f"full codec, vs the {_TRANSPORT_RTT_TARGET_US} us cache-hit "
+        "serving p50 it must undercut (ISSUE 17 acceptance): "
+        f"**{'PASS' if record['pass'] else 'FAIL'}**.  The transit row is "
+        "what the ring replaces (socket syscalls + kernel copies); the "
+        "codec row adds encode/decode, which every transport pays "
+        "identically.  Full-stack van arms on this 1-core container are "
+        "dominated by GIL scheduling + the ring reader's adaptive poll "
+        "sleep — compare them to each other, not to the ring rows.\n\n"
+        "| live conns (epoll fan-in) | deliver msgs/s |\n|---|---|\n"
+        + fan_rows +
+        f"\nRate retention at {record['fanin'][-1]['conns']} conns vs "
+        f"{record['fanin'][0]['conns']}: "
+        f"**{record['fanin_retention']}x** — one event-loop thread, no "
+        "per-connection threads (the 10k-conn soak in "
+        "tests/test_transport2.py asserts the same shape on p99).\n"
+    )
+    _splice_baseline(
+        _TRANSPORT_BEGIN,
+        _TRANSPORT_END,
+        body,
+        "## Transport v2: shm ring + epoll fan-in "
+        "(auto-recorded by bench.py --transport)",
+    )
+
+
 def emit_observability_artifacts(trace_dir: str) -> None:
     """``--trace-dir`` side artifacts beyond the bench's own phase trace:
     run a tiny 2-worker/2-server metered cluster and drop (a) per-node
@@ -4672,6 +5019,30 @@ def _dispatch() -> None:
         _emit(record)
         print("\n".join(lines), file=sys.stderr)
         record_hier(record, lines)
+        return
+    if "--transport" in sys.argv[1:]:
+        # host-side only: sockets + shm rings, no TPU probe, no jax
+        _start_watchdog("transport_shm_rtt_p50_us", "us")
+        try:
+            record, lines = run_transport()
+        except Exception as e:  # noqa: BLE001 — the JSON line must still emit
+            _emit(
+                {
+                    "metric": "transport_shm_rtt_p50_us",
+                    "value": 0.0,
+                    "unit": "us",
+                    "vs_baseline": _TRANSPORT_RTT_TARGET_US,
+                    "error": f"transport failed: {type(e).__name__}: {e}"[:500],
+                }
+            )
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
+            return
+        _emit(record)
+        print("\n".join(lines), file=sys.stderr)
+        if not record.get("error"):
+            record_transport(record, lines)
         return
     if micro:
         _start_watchdog("micro_scatter_add_pallas_speedup_vs_xla", "x")

@@ -365,6 +365,44 @@ class CheckpointConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class TransportConfig:
+    """Transport v2 knobs (ISSUE 17): wire backend + colocated shm rings.
+
+    ``TcpVan`` consumes this; both knobs also answer to env overrides
+    (``PS_WIRE=epoll|threaded``, ``PS_NO_SHM=1``) so tests and rollouts can
+    flip backends without plumbing a config through every constructor.
+    """
+
+    #: native wire backend: "epoll" (one event-loop thread multiplexing all
+    #: connections, vectored writev sends, bounded write queues —
+    #: ``native/src/epollvan.cc``) or "threaded" (the PR 6 thread-per-
+    #: connection core, ``native/src/tcpvan.cc``).  "epoll" quietly falls
+    #: back to "threaded" when the epoll backend fails to build.
+    wire: str = "epoll"
+    #: negotiate shared-memory rings for colocated links (same boot id):
+    #: frames bypass TCP via ``core/shm_ring.py``; any doubt (ring full,
+    #: peer dead, old peer that never acks) degrades per-frame to TCP.
+    shm: bool = True
+    #: per-direction ring capacity in bytes.
+    ring_capacity: int = 4 << 20
+    #: how long a sender waits for ring space before falling back to TCP
+    #: for that frame (counted in ``ring_full``).
+    ring_wait_s: float = 0.0005
+
+    def __post_init__(self) -> None:
+        if self.wire not in ("epoll", "threaded"):
+            raise ValueError(f"wire must be epoll|threaded, got {self.wire!r}")
+        if self.ring_capacity < 4096:
+            raise ValueError(
+                f"ring_capacity must be >= 4096, got {self.ring_capacity!r}"
+            )
+        if self.ring_wait_s < 0:
+            raise ValueError(
+                f"ring_wait_s must be >= 0, got {self.ring_wait_s!r}"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
 class TableConfig:
     """A KV table: the unit the reference range-partitions across servers.
 

@@ -13,6 +13,15 @@ directly), member key bytes concatenate into a single uint8 plane, and the
 only new bytes are one header plus a compact tuple index in the meta
 section.
 
+Transport v2 extends "re-encode-free" down to the syscall: because member
+value arrays stay separate planes here, ``frame.encode_vec`` hands the
+transport the bundle as ``[header+meta] + plane`` views and the epoll
+backend's vectored send (``ps_van_send_vec`` -> ``writev``) puts them on
+the wire without EVER concatenating host-side — no join of the bundle
+body exists anywhere between the members' original buffers and the kernel.
+The same segment list slice-assigns piecewise into a colocated shm ring
+(``core/shm_ring.py``), so both planes inherit the zero-concat property.
+
 Stack position is OUTERMOST::
 
     CoalescingVan(ReliableVan(ChaosVan(LoopbackVan(filter_chain))))

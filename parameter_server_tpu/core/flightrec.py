@@ -48,6 +48,11 @@ EVENTS = frozenset({
     "frame.recv",
     # transport: wire-level rejects (CRC / undecodable / unframeable)
     "frame.reject",
+    # transport v2 backpressure (core/tcp_van.py): a colocated shm ring
+    # refusing a frame (degraded to TCP or dropped for retransmit) and the
+    # epoll backend's bounded per-conn write queue refusing a vectored send
+    "net.ring_full",
+    "net.writeq_full",
     # reliable delivery (core/resender.py)
     "resend.retransmit",
     "resend.dup",

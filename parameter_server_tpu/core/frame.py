@@ -622,6 +622,30 @@ def encode(msg: Message) -> bytes:
         fast = _fast_encode(msg)
         if fast is not None:
             return fast
+    head, meta, planes, _planes_len = _encode_parts(msg)
+    return b"".join([head, meta] + planes)
+
+
+def encode_vec(msg: Message) -> Tuple[list, int]:
+    """Message -> ``(segments, total_len)`` for vectored (``writev``/shm)
+    sends: byte-identical to :func:`encode` when the segments are laid end
+    to end, but the value planes stay SEPARATE zero-copy views over the
+    original array buffers — a coalesced bundle's member gradients go from
+    their source buffers to the wire without ever concatenating host-side.
+    The first segment is the fixed header + meta section (one small
+    bytearray); every following segment is a plane ``memoryview``."""
+    if msg.keys is None and not msg.values:
+        fast = _fast_encode(msg)
+        if fast is not None:
+            return [fast], len(fast)
+    head, meta, planes, planes_len = _encode_parts(msg)
+    head += meta  # bytearray extend: header+meta ride one iovec slot
+    return [head] + planes, len(head) + planes_len
+
+
+def _encode_parts(msg: Message) -> Tuple[bytearray, bytearray, list, int]:
+    """Shared general-path body of :func:`encode`/:func:`encode_vec`:
+    ``(header, meta, plane_views, planes_len)``."""
     arrays = []
     for a in ([msg.keys] if msg.keys is not None else []) + list(msg.values):
         arrays.append(_contig(a))
@@ -710,7 +734,7 @@ def encode(msg: Message) -> bytes:
     )
     _pack_I_into(head, HEADER_SIZE - 4,
                  zlib.crc32(memoryview(head)[: HEADER_SIZE - 4]))
-    return b"".join([head, meta] + planes)
+    return head, meta, planes, planes_len
 
 
 @dataclasses.dataclass(frozen=True)
@@ -811,14 +835,38 @@ def decode(buf, *, verify: bool = True) -> Message:
     rejected (ChaosVan flips plane bytes exclusively, so this never fires
     on its injection path).
     """
-    info = peek(buf)
+    # header handling is inlined (same checks, same order, same typed
+    # rejects as peek()) rather than routed through peek(): this is the
+    # per-frame hot path of every wire AND shm receive, and building a
+    # frozen FrameInfo per frame costs more than the whole plane CRC
+    if len(buf) < HEADER_SIZE:
+        raise FrameError(
+            f"truncated frame: {len(buf)} bytes < {HEADER_SIZE}-byte header"
+        )
+    (
+        magic, version, kind_i, flags, n_arrays,
+        seq, inc, epoch, e2e, plane_crc, meta_crc, meta_len, planes_len,
+        hcrc,
+    ) = HEADER.unpack_from(buf, 0)
     mv = memoryview(buf) if not isinstance(buf, memoryview) else buf
-    if verify and not verify_planes(mv, info):
+    if zlib.crc32(mv[: HEADER_SIZE - 4]) != hcrc:
+        raise FrameError("header CRC mismatch (garbled header)")
+    if magic != MAGIC:
+        raise FrameError(f"bad magic {magic!r}")
+    if version != VERSION:
+        raise FrameError(f"unsupported frame version {version}")
+    if kind_i >= len(_KINDS):
+        raise FrameError(f"bad task kind {kind_i}")
+    meta_end = HEADER_SIZE + meta_len
+    if meta_end + planes_len != len(buf):
+        raise FrameError(
+            f"frame length mismatch: header says "
+            f"{HEADER_SIZE}+{meta_len}+{planes_len}, buffer has {len(buf)}"
+        )
+    if verify and zlib.crc32(mv[meta_end : meta_end + planes_len]) != plane_crc:
         raise FrameError("plane CRC mismatch (corrupt frame body)")
-    pos = HEADER_SIZE
-    meta_end = pos + info.meta_len
-    meta = mv[pos:meta_end]
-    if zlib.crc32(meta) != info.meta_crc:
+    meta = mv[HEADER_SIZE:meta_end]
+    if zlib.crc32(meta) != meta_crc:
         raise FrameError("meta CRC mismatch (corrupt meta section)")
     customer, p = _dec_obj(meta, 0)
     sender, p = _dec_obj(meta, p)
@@ -828,21 +876,45 @@ def decode(buf, *, verify: bool = True) -> Message:
     payload, p = _dec_obj(meta, p)
     if not isinstance(payload, dict):
         raise FrameError("meta section inconsistent with header")
-    # manifest block: fixed binary records, one per plane (see encode)
-    manifests = []
+    # reinstate the lifted stamps: layers above the codec see the payload
+    # dict bitwise as the sender's stack stamped it
+    if flags & FLAG_SEQ:
+        payload[SEQ_KEY] = seq
+    if flags & FLAG_INC:
+        payload[INCARNATION_KEY] = inc
+    if flags & FLAG_EPOCH:
+        payload[ROUTING_EPOCH_KEY] = epoch
+    if flags & FLAG_E2E_CRC:
+        payload[CRC_KEY] = e2e
+    # manifest block (fixed binary records, one per plane — see encode)
+    # fused with plane reconstruction: one pass, no intermediate tuples
+    arrays = []
+    off = meta_end
     try:
-        for _ in range(info.n_arrays):
+        for _ in range(n_arrays):
             dlen = meta[p]
             p += 1
             dt = _str_dtype(bytes(meta[p : p + dlen]).decode("ascii"))
             p += dlen
             ndim = meta[p]
             p += 1
-            shape = _shape_struct(ndim).unpack_from(meta, p) if ndim else ()
-            p += 8 * ndim
-            if any(d < 0 for d in shape):
-                raise FrameError(f"negative plane dim in manifest: {shape}")
-            manifests.append((dt, shape))
+            if ndim:
+                shape = _shape_struct(ndim).unpack_from(meta, p)
+                p += 8 * ndim
+                n = 1
+                for d in shape:
+                    if d < 0:
+                        raise FrameError(
+                            f"negative plane dim in manifest: {shape}"
+                        )
+                    n *= d
+            else:
+                shape = ()
+                n = 1
+            arrays.append(
+                np.frombuffer(mv, dtype=dt, count=n, offset=off).reshape(shape)
+            )
+            off += n * dt.itemsize
     except FrameError:
         raise
     except (IndexError, struct.error, UnicodeDecodeError, TypeError,
@@ -850,40 +922,17 @@ def decode(buf, *, verify: bool = True) -> Message:
         # same contract as _dec_obj: EVERY decode failure mode is the one
         # typed reject — nothing escapes to kill the recv thread
         raise FrameError(f"garbled manifest block: {e}") from e
-    # reinstate the lifted stamps: layers above the codec see the payload
-    # dict bitwise as the sender's stack stamped it
-    if info.seq is not None:
-        payload[SEQ_KEY] = info.seq
-    if info.incarnation is not None:
-        payload[INCARNATION_KEY] = info.incarnation
-    if info.epoch is not None:
-        payload[ROUTING_EPOCH_KEY] = info.epoch
-    if info.e2e_crc is not None:
-        payload[CRC_KEY] = info.e2e_crc
-    arrays = []
-    off = meta_end
-    try:
-        for dt, shape in manifests:
-            n = 1
-            for d in shape:
-                n *= d
-            arrays.append(
-                np.frombuffer(mv, dtype=dt, count=n, offset=off).reshape(shape)
-            )
-            off += n * dt.itemsize
-    except (ValueError, TypeError, OverflowError) as e:
-        raise FrameError(f"garbled manifest: {e}") from e
-    keys = arrays.pop(0) if info.flags & FLAG_HAS_KEYS else None
+    keys = arrays.pop(0) if flags & FLAG_HAS_KEYS else None
     return Message(
         task=Task(
-            kind=info.kind, customer=customer, time=time_,
+            kind=_KINDS[kind_i], customer=customer, time=time_,
             wait_time=wait_time, payload=payload,
         ),
         sender=sender,
         recver=recver,
         keys=keys,
         values=arrays,
-        is_request=info.is_request,
+        is_request=bool(flags & FLAG_REQUEST),
     )
 
 

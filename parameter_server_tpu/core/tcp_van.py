@@ -1,27 +1,65 @@
-"""TcpVan: the DCN-plane transport over native TCP sockets.
+"""TcpVan: the DCN-plane transport over native TCP sockets + shm rings.
 
 Reference analogue: ``src/system/van.h/.cc`` — ZeroMQ sockets, a node table,
 and a receive thread [U] (SURVEY.md #2).  The socket/framing/thread core is
-native C++ (``native/src/tcpvan.cc``, loaded via ctypes); this module owns
-what the reference kept in C++ around protobuf: routing (node id -> address),
-message serialization, per-link filter chains, and handler dispatch.
+native C++ (loaded via ctypes); this module owns what the reference kept in
+C++ around protobuf: routing (node id -> address), message serialization,
+per-link filter chains, and handler dispatch.
+
+Transport v2 (ISSUE 17) — two planes behind the same Van contract:
+
+- **Wire backend**: ``native/src/epollvan.cc`` (default) multiplexes every
+  connection on ONE event-loop thread with non-blocking vectored ``writev``
+  sends and bounded per-connection write queues; ``native/src/tcpvan.cc``
+  (``PS_WIRE=threaded`` or ``TransportConfig(wire="threaded")``) is the
+  PR 6 thread-per-connection core.  Either way the wire format is the flat
+  frame of ``core/frame.py`` inside ``[u32 magic][u64 len]`` framing, and
+  the receive path hands Python a BORROWED native buffer decoded zero-copy
+  (``np.frombuffer`` views) and freed only when the last view dies — no
+  ``ctypes.string_at`` copy on either backend.
+- **Shared-memory fast path**: links whose peers share a kernel boot id
+  negotiate a pair of SPSC mmap rings (``core/shm_ring.py``) over the TCP
+  connection; data frames then bypass TCP entirely, decoded zero-copy
+  straight off the ring.  TCP stays attached as the control/fallback
+  plane: a full ring degrades that one frame to TCP (counted
+  ``ring_full``), and any conn death tears the rings down, so chaos,
+  migration, and restart paths behave exactly as before.  Old peers never
+  answer the offer — the link silently stays pure TCP (MIGRATION.md
+  rolling-upgrade note).
+
+Shm negotiation and the FIFO cutover.  The handshake rides the TCP conn it
+upgrades (``__shmneg__`` control frames, never delivered to endpoints)::
+
+    offer(boot, path)     initiator created ring R_i (it will WRITE R_i)
+    accept(boot, path)    acceptor attached R_i as a gated reader and
+                          created R_a; its own tx stays OFF
+    cutover               each side, at the instant it enables its tx
+    confirm(ok)           initiator attached R_a; acceptor enables its tx
+
+Per-link FIFO survives the transition because every data send for a conn —
+ring or TCP — runs under that conn's send lock, the ``cutover`` marker is
+written to the TCP stream under the SAME lock in the same act that enables
+the ring, and the receiver's ring reader is GATED until the dispatch thread
+(which enqueues TCP frames in stream order) has processed the marker.  So
+every TCP frame sent before the flip is in its endpoint inbox before the
+first ring frame is, and no data frame ever follows the marker on TCP.
+
+Ring-full backpressure is the one place the two planes can reorder: the
+degraded frame rides TCP behind ring frames already in flight.  Links with
+no stateful filters tolerate that (the reliable layer dedups and the stack
+already absorbs ChaosVan's reorder injection), so they degrade per frame;
+links running a stateful chain (key caching needs exact wire FIFO) DROP the
+frame instead — ``on_send_failed`` rolls the codec back and the resender
+retransmits — trading one retransmit for cache integrity.
 
 Design notes:
 
 - One ``TcpVan`` per *process*; multiple logical nodes (scheduler + servers +
   workers colocated on a host) may bind on it, exactly like LoopbackVan.
-- Wire format per frame: the flat self-describing layout of
-  ``core/frame.py`` — 52-byte fixed header (magic/version/kind/flags,
-  seq/incarnation/epoch stamps, plane+meta CRC32s, section lengths), a
-  tag-encoded
-  binary meta section (NO pickle anywhere on this path), then the raw
-  contiguous key/value planes.  Arrays ride as raw bytes both ways (the
-  SArray zero-copy role: sends read array buffers directly, receives take
-  ``frombuffer`` views of the received buffer), and malformed or corrupted
-  frames are rejected with a typed ``FrameError`` off the header alone.
 - Filters (key caching / compression / quantization — core/filters.py) apply
   per link on the encoded Message before serialization, matching the
-  reference's RemoteNode filter stacks.
+  reference's RemoteNode filter stacks; which plane the frame then rides is
+  decided below the filters, so they see one logical link either way.
 - Unreachable/unknown destinations drop the message and return False — same
   contract as LoopbackVan, which the failure-detection layer builds on.
 """
@@ -30,43 +68,99 @@ from __future__ import annotations
 
 import ctypes
 import logging
+import os
 import socket
 import threading
+import weakref
 from typing import Callable, Dict, Optional, Tuple
 
+import numpy as np
+
 from parameter_server_tpu import native
-from parameter_server_tpu.core import flightrec, frame
+from parameter_server_tpu.config import TransportConfig
+from parameter_server_tpu.core import flightrec, frame, shm_ring
 from parameter_server_tpu.core.frame import FrameError
-from parameter_server_tpu.core.messages import Message
+from parameter_server_tpu.core.messages import Message, Task, TaskKind
 from parameter_server_tpu.core.van import Van, _Endpoint
 
 _u8p = ctypes.POINTER(ctypes.c_uint8)
+_u8pp = ctypes.POINTER(_u8p)
+
+#: internal handshake customer — intercepted by the dispatch loop, never
+#: delivered to endpoints.  Old peers (pre-v2) drop these frames on the
+#: floor (no endpoint named ``__shmneg__``), which IS the negotiation
+#: failure path: silence leaves the link pure TCP.
+SHMNEG_CUSTOMER = "__shmneg__"
+
+#: env overrides (see :class:`~parameter_server_tpu.config.TransportConfig`)
+WIRE_ENV = "PS_WIRE"
+NO_SHM_ENV = "PS_NO_SHM"
+
+#: native iovec cap of the epoll backend (kMaxIov in epollvan.cc); frames
+#: with more segments take the joined single-buffer path.
+_MAX_IOV = 64
+
+# _send_on_conn return codes (superset of the native ps_van_send contract)
+_SEND_OK = 0
+_SEND_DEAD = -1        # conn dead: drop conn, tear down shm, reconnect later
+_SEND_WRITEQ_FULL = -2  # epoll write queue refused the frame; conn is fine
+_SEND_RING_DROP = -4   # ring full on a stateful-filtered link: frame dropped
+
+
+def _setup_sigs(lib: ctypes.CDLL) -> ctypes.CDLL:
+    if getattr(lib, "_ps_sigs", False):
+        return lib
+    lib.ps_van_new.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int)
+    ]
+    lib.ps_van_new.restype = ctypes.c_void_p
+    lib.ps_van_connect.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+    lib.ps_van_send.argtypes = [ctypes.c_void_p, ctypes.c_int, _u8p, ctypes.c_int64]
+    lib.ps_van_recv.argtypes = [
+        ctypes.c_void_p, ctypes.c_double, ctypes.POINTER(_u8p),
+        ctypes.POINTER(ctypes.c_int),
+    ]
+    lib.ps_van_recv.restype = ctypes.c_int64
+    lib.ps_van_free.argtypes = [_u8p]
+    lib.ps_van_disconnect.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.ps_van_close.argtypes = [ctypes.c_void_p]
+    lib.ps_van_port.argtypes = [ctypes.c_void_p]
+    lib.ps_van_bytes_sent.argtypes = [ctypes.c_void_p]
+    lib.ps_van_bytes_sent.restype = ctypes.c_int64
+    lib.ps_van_bytes_recv.argtypes = [ctypes.c_void_p]
+    lib.ps_van_bytes_recv.restype = ctypes.c_int64
+    try:
+        # epoll backend only: vectored send + typed write-queue counter
+        lib.ps_van_send_vec.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, _u8pp,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+        ]
+        lib.ps_van_writeq_full.argtypes = [ctypes.c_void_p]
+        lib.ps_van_writeq_full.restype = ctypes.c_int64
+    except AttributeError:
+        pass
+    lib._ps_sigs = True
+    return lib
 
 
 def _lib() -> ctypes.CDLL:
-    lib = native.load("tcpvan", required=True)
-    if not getattr(lib, "_ps_sigs", False):
-        lib.ps_van_new.argtypes = [
-            ctypes.c_char_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int)
-        ]
-        lib.ps_van_new.restype = ctypes.c_void_p
-        lib.ps_van_connect.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
-        lib.ps_van_send.argtypes = [ctypes.c_void_p, ctypes.c_int, _u8p, ctypes.c_int64]
-        lib.ps_van_recv.argtypes = [
-            ctypes.c_void_p, ctypes.c_double, ctypes.POINTER(_u8p),
-            ctypes.POINTER(ctypes.c_int),
-        ]
-        lib.ps_van_recv.restype = ctypes.c_int64
-        lib.ps_van_free.argtypes = [_u8p]
-        lib.ps_van_disconnect.argtypes = [ctypes.c_void_p, ctypes.c_int]
-        lib.ps_van_close.argtypes = [ctypes.c_void_p]
-        lib.ps_van_port.argtypes = [ctypes.c_void_p]
-        lib.ps_van_bytes_sent.argtypes = [ctypes.c_void_p]
-        lib.ps_van_bytes_sent.restype = ctypes.c_int64
-        lib.ps_van_bytes_recv.argtypes = [ctypes.c_void_p]
-        lib.ps_van_bytes_recv.restype = ctypes.c_int64
-        lib._ps_sigs = True
-    return lib
+    """Legacy threaded backend (kept for ``PS_WIRE=threaded`` and callers
+    that import this directly)."""
+    return _setup_sigs(native.load("tcpvan", required=True))
+
+
+def _load_wire(wire: str) -> Tuple[ctypes.CDLL, str]:
+    """Resolve the wire backend: requested (env beats config), with a quiet
+    fallback from epoll to threaded when the epoll core fails to build."""
+    wire = os.environ.get(WIRE_ENV, wire)
+    if wire == "epoll":
+        lib = native.load("epollvan")
+        if lib is not None:
+            return _setup_sigs(lib), "epoll"
+        logging.getLogger(__name__).warning(
+            "tcpvan: epoll backend unavailable; falling back to threaded"
+        )
+    return _lib(), "threaded"
 
 
 # ------------------------------------------------------------ serialization
@@ -80,23 +174,66 @@ def serialize_message(msg: Message) -> bytes:
 
 
 def deserialize_message(buf) -> Message:
-    """Flat frame bytes -> Message; arrays are zero-copy ``frombuffer``
+    """Flat frame buffer -> Message; arrays are zero-copy ``frombuffer``
     views.  Raises :class:`~parameter_server_tpu.core.frame.FrameError`
     (typed) on truncated/garbled/corrupt frames — including a plane CRC
     check made in one pass over the raw buffer before any reconstruction."""
     return frame.decode(buf)
 
 
+# DNS memoization (ISSUE 17 satellite): gethostbyname runs once per host,
+# not on every cold connect; a failed connect invalidates the entry so a
+# migrated/re-addressed host re-resolves on the retry.
+_DNS_LOCK = threading.Lock()
+_DNS_CACHE: Dict[str, str] = {}
+
+
 def _resolve(host: str) -> str:
-    """inet_addr in the native core needs a numeric IPv4."""
-    return socket.gethostbyname(host)
+    """inet_addr in the native core needs a numeric IPv4 (memoized)."""
+    with _DNS_LOCK:
+        ip = _DNS_CACHE.get(host)
+    if ip is not None:
+        return ip
+    ip = socket.gethostbyname(host)
+    with _DNS_LOCK:
+        _DNS_CACHE[host] = ip
+    return ip
+
+
+def _dns_invalidate(host: str) -> None:
+    with _DNS_LOCK:
+        _DNS_CACHE.pop(host, None)
+
+
+def _free_native(lib: ctypes.CDLL, addr: int) -> None:
+    """weakref.finalize target: release a borrowed native recv buffer once
+    the last decoded view over it has died."""
+    lib.ps_van_free(ctypes.cast(addr, _u8p))
+
+
+class _ShmLink:
+    """One colocated link in (or past) negotiation: the ring we write
+    (``tx``), the ring we read (``rx`` + its gated reader thread), and the
+    TCP conn that anchors the link's liveness (conn death tears it down)."""
+
+    __slots__ = ("conn", "addr", "tx", "rx", "reader", "gate")
+
+    def __init__(self, conn: int, addr: Optional[Tuple[str, int]] = None) -> None:
+        self.conn = conn
+        self.addr = addr  # set on the initiator side only
+        self.tx: Optional[shm_ring.ShmRing] = None
+        self.rx: Optional[shm_ring.ShmRing] = None
+        self.reader: Optional[threading.Thread] = None
+        #: opened by the peer's ``cutover`` marker: until then the reader
+        #: must not deliver (FIFO vs TCP frames still in the dispatch queue)
+        self.gate = threading.Event()
 
 
 # ------------------------------------------------------------------- TcpVan
 
 
 class TcpVan(Van):
-    """Cross-host Van over the native TCP core.
+    """Cross-host Van over the native wire core + colocated shm rings.
 
     Usage::
 
@@ -113,8 +250,11 @@ class TcpVan(Van):
         *,
         filter_chain=None,
         advertise_host: Optional[str] = None,
+        transport: Optional[TransportConfig] = None,
     ) -> None:
-        self._lib = _lib()
+        self.transport = transport or TransportConfig()
+        self._lib, self.wire_backend = _load_wire(self.transport.wire)
+        self._send_vec = getattr(self._lib, "ps_van_send_vec", None)
         actual = ctypes.c_int()
         self._van = self._lib.ps_van_new(
             host.encode(), port, ctypes.byref(actual)
@@ -137,11 +277,30 @@ class TcpVan(Van):
         #: for yet — e.g. a pull racing ahead of the node-table broadcast.
         self._peer_conns: Dict[str, int] = {}
         self._link_locks: Dict[tuple, threading.Lock] = {}
+        #: per-conn send locks: the ring-vs-TCP choice, the write itself,
+        #: and the shm cutover are atomic per conn (the FIFO story above)
+        self._conn_locks: Dict[int, threading.Lock] = {}
         self._lock = threading.Lock()
         self._closed = threading.Event()
         self.sent_messages = 0
         self.dropped_messages = 0
         self.frame_rejects = 0
+        # -- shm fast path state ------------------------------------------
+        self.shm_enabled = (
+            self.transport.shm and not os.environ.get(NO_SHM_ENV)
+        )
+        self._boot_id = shm_ring.boot_id()
+        #: conn id -> link state (from first offer until teardown)
+        self._shm_links: Dict[int, _ShmLink] = {}
+        #: conn id -> LIVE tx ring (the flip _send_on_conn checks);
+        #: entered only under the conn's send lock, with the cutover marker
+        self._shm_tx_live: Dict[int, shm_ring.ShmRing] = {}
+        self.shm_frames_sent = 0
+        self.shm_bytes_sent = 0
+        self.shm_frames_recv = 0
+        self.shm_bytes_recv = 0
+        self.ring_fulls = 0    # frames hitting a full ring (degraded/dropped)
+        self.writeq_fulls = 0  # vectored sends refused by the write queue
         self._dispatch = threading.Thread(
             target=self._dispatch_loop, name=f"tcpvan-dispatch-{self.port}",
             daemon=True,
@@ -195,9 +354,9 @@ class TcpVan(Van):
             return self._send_via_peer_conn(msg)
         if self.filter_chain is not None:
             # Stateful filters (key caching) need wire-FIFO per link: hold the
-            # link lock across encode AND the socket write so a later encode
-            # cannot overtake an earlier frame onto the wire (LoopbackVan
-            # documents the same invariant).
+            # link lock across encode AND the transport write so a later
+            # encode cannot overtake an earlier frame onto the wire/ring
+            # (LoopbackVan documents the same invariant).
             with self._lock:
                 ll = self._link_locks.setdefault(
                     (msg.sender, msg.recver), threading.Lock()
@@ -205,14 +364,14 @@ class TcpVan(Van):
             with ll:
                 orig = msg
                 msg = self.filter_chain.encode(msg)
-                ok = self._send_wire(serialize_message(msg), addr)
+                ok = self._send_wire(msg, addr, stateful=True)
                 if not ok:
                     # the receiver never saw this frame — stateful filters
                     # (key caching) must roll back or the link poisons, and
                     # byte counters must un-commit (ADVICE r3)
                     self.filter_chain.on_send_failed(orig, msg)
                 return ok
-        return self._send_wire(serialize_message(msg), addr)
+        return self._send_wire(msg, addr)
 
     def _send_via_peer_conn(self, msg: Message) -> bool:
         """No route: answer over the connection the peer last spoke on."""
@@ -235,24 +394,26 @@ class TcpVan(Van):
             if sub is None:
                 sub = self._stateless_chain = self.filter_chain.stateless_subchain()
             msg = sub.encode(msg)
-        data = serialize_message(msg)
-        buf = ctypes.cast(ctypes.c_char_p(data), _u8p)
-        rc = self._lib.ps_van_send(self._van, conn, buf, len(data))
+        rc = self._send_on_conn(conn, msg)
         with self._lock:
-            if rc == 0:
+            if rc == _SEND_OK:
                 self.sent_messages += 1
             else:
                 self.dropped_messages += 1
-                if self._peer_conns.get(msg.recver) == conn:
+                if rc == _SEND_DEAD and self._peer_conns.get(msg.recver) == conn:
                     self._peer_conns.pop(msg.recver, None)  # stale conn
-        if rc != 0 and sub is not None:
+        if rc != _SEND_OK and sub is not None:
             # un-commit codec byte counters for a frame that never hit the
             # wire (same rollback as the routed path; pull replies are the
             # bulk of DCN bytes, so this path overstated worst)
             sub.on_send_failed(orig, msg)
-        return rc == 0
+        if rc == _SEND_DEAD:
+            self._teardown_shm(conn)
+        return rc == _SEND_OK
 
-    def _send_wire(self, data: bytes, addr: Tuple[str, int]) -> bool:
+    def _send_wire(
+        self, msg: Message, addr: Tuple[str, int], *, stateful: bool = False
+    ) -> bool:
         if self._closed.is_set() or self._van is None:
             with self._lock:
                 self.dropped_messages += 1
@@ -262,20 +423,95 @@ class TcpVan(Van):
             with self._lock:
                 self.dropped_messages += 1
             return False
-        # zero-copy: point at the bytes' buffer (send only reads it)
-        buf = ctypes.cast(ctypes.c_char_p(data), _u8p)
-        rc = self._lib.ps_van_send(self._van, conn, buf, len(data))
+        rc = self._send_on_conn(conn, msg, stateful=stateful)
         with self._lock:
-            if rc == 0:
+            if rc == _SEND_OK:
                 self.sent_messages += 1
             else:
                 self.dropped_messages += 1
-                # force reconnect next time; release the native fd + thread
-                if self._conns.get(addr) == conn:
+                # a dead conn forces a reconnect next time; write-queue/ring
+                # backpressure keeps the conn: the frame is dropped for the
+                # resender to retransmit, nothing below is broken
+                if rc == _SEND_DEAD and self._conns.get(addr) == conn:
                     self._conns.pop(addr, None)
-        if rc != 0:
+        if rc == _SEND_DEAD:
+            self._teardown_shm(conn)
             self._lib.ps_van_disconnect(self._van, conn)
-        return rc == 0
+        return rc == _SEND_OK
+
+    def _conn_lock(self, conn: int) -> threading.Lock:
+        with self._lock:
+            return self._conn_locks.setdefault(conn, threading.Lock())
+
+    def _send_on_conn(
+        self, conn: int, msg: Message, *, stateful: bool = False
+    ) -> int:
+        """The per-conn choke point: ring if live, else TCP, atomically.
+
+        Returns ``_SEND_OK``/``_SEND_DEAD``/``_SEND_WRITEQ_FULL``/
+        ``_SEND_RING_DROP``.  ``stateful`` marks frames from a stateful
+        filter chain: on ring-full those DROP (caller rolls the codec back,
+        resender retransmits) instead of degrading to TCP, because the
+        degraded frame would arrive out of order and poison key-cache state.
+        """
+        with self._conn_lock(conn):
+            ring = self._shm_tx_live.get(conn)
+            if ring is not None and not ring.closed:
+                segs, total = frame.encode_vec(msg)
+                if ring.write(segs, total, timeout=self.transport.ring_wait_s):
+                    with self._lock:
+                        self.shm_frames_sent += 1
+                        self.shm_bytes_sent += total
+                    return _SEND_OK
+                with self._lock:
+                    self.ring_fulls += 1
+                flightrec.record(
+                    "net.ring_full", recver=msg.recver, nbytes=total,
+                )
+                if stateful:
+                    return _SEND_RING_DROP
+                return self._wire_send_segs(conn, segs, total)
+            return self._wire_send_msg(conn, msg)
+
+    def _wire_send_msg(self, conn: int, msg: Message) -> int:
+        if self._send_vec is None:
+            data = serialize_message(msg)
+            buf = ctypes.cast(ctypes.c_char_p(data), _u8p)
+            return self._lib.ps_van_send(self._van, conn, buf, len(data))
+        segs, total = frame.encode_vec(msg)
+        return self._wire_send_segs(conn, segs, total)
+
+    def _wire_send_segs(self, conn: int, segs: list, total: int) -> int:
+        """Vectored send on the epoll backend: a coalesced bundle's header
+        and member planes ride one ``writev`` without ever concatenating
+        host-side.  Frames over the native iovec cap (or on the threaded
+        backend) take the joined single-buffer path."""
+        if self._send_vec is not None and len(segs) < _MAX_IOV:
+            n = len(segs)
+            bufs = (_u8p * n)()
+            lens = (ctypes.c_int64 * n)()
+            # uint8 views resolve each segment (bytes / bytearray / plane
+            # memoryview) to a stable pointer without copying; `holders`
+            # pins the buffers for the duration of the call (the native
+            # side copies any unsent tail before returning).
+            holders = []
+            for i, s in enumerate(segs):
+                a = np.frombuffer(s, dtype=np.uint8)
+                holders.append(a)
+                bufs[i] = a.ctypes.data_as(_u8p)
+                lens[i] = a.nbytes
+            rc = self._lib.ps_van_send_vec(self._van, conn, bufs, lens, n)
+            del holders
+            if rc == _SEND_WRITEQ_FULL:
+                with self._lock:
+                    self.writeq_fulls += 1
+                flightrec.record("net.writeq_full", conn=conn, nbytes=total)
+            if rc != -3:  # -3: over the native seg cap — join instead
+                return rc
+        data = b"".join(bytes(s) if not isinstance(s, bytes) else s
+                        for s in segs)
+        buf = ctypes.cast(ctypes.c_char_p(data), _u8p)
+        return self._lib.ps_van_send(self._van, conn, buf, len(data))
 
     def _get_conn(self, addr: Tuple[str, int]) -> Optional[int]:
         with self._lock:
@@ -288,14 +524,179 @@ class TcpVan(Van):
             return None
         conn = self._lib.ps_van_connect(self._van, ip.encode(), addr[1])
         if conn < 0:
+            # the cached resolution may be stale (host re-addressed after a
+            # migration): drop it so the retry resolves fresh
+            _dns_invalidate(addr[0])
             return None
         with self._lock:
             # lost race: keep the first connection
             existing = self._conns.setdefault(addr, conn)
         if existing != conn:
-            # release the abandoned duplicate (fd + native recv thread)
+            # release the abandoned duplicate (fd + native recv state)
             self._lib.ps_van_disconnect(self._van, conn)
+        elif self.shm_enabled:
+            self._shm_offer(conn, addr)
         return existing
+
+    # -- shm negotiation -----------------------------------------------------
+    def _neg_send(self, conn: int, op: str, **fields) -> None:
+        payload = {"op": op, "boot": self._boot_id, **fields}
+        m = Message(
+            task=Task(TaskKind.CONTROL, SHMNEG_CUSTOMER, payload=payload),
+            sender="", recver="",
+        )
+        data = frame.encode(m)
+        buf = ctypes.cast(ctypes.c_char_p(data), _u8p)
+        self._lib.ps_van_send(self._van, conn, buf, len(data))
+
+    def _shm_offer(self, conn: int, addr: Tuple[str, int]) -> None:
+        """Initiator: create our tx ring for this link and offer it."""
+        try:
+            ring = shm_ring.ShmRing.create(self.transport.ring_capacity)
+        except OSError:
+            return
+        link = _ShmLink(conn, addr)
+        link.tx = ring  # created, but OFF until the peer's accept
+        with self._lock:
+            self._shm_links[conn] = link
+        self._neg_send(conn, "offer", path=ring.path)
+
+    def _shm_on_offer(self, conn: int, payload: dict) -> None:
+        if (
+            not self.shm_enabled
+            or payload.get("boot") != self._boot_id
+            or not isinstance(payload.get("path"), str)
+        ):
+            self._neg_send(conn, "nak")
+            return
+        try:
+            rx = shm_ring.ShmRing.attach(payload["path"])
+            tx = shm_ring.ShmRing.create(self.transport.ring_capacity)
+        except (OSError, shm_ring.ShmRingError):
+            self._neg_send(conn, "nak")
+            return
+        link = _ShmLink(conn)
+        link.rx = rx
+        link.tx = tx  # OFF until the initiator's confirm
+        with self._lock:
+            self._shm_links[conn] = link
+        self._start_reader(link)  # gated: waits for the initiator's cutover
+        self._neg_send(conn, "accept", path=tx.path)
+
+    def _shm_on_accept(self, conn: int, payload: dict) -> None:
+        with self._lock:
+            link = self._shm_links.get(conn)
+        if (
+            link is None or link.addr is None or link.rx is not None
+            or payload.get("boot") != self._boot_id
+            or not isinstance(payload.get("path"), str)
+        ):
+            return  # not ours / stale / duplicate accept: ignore
+        try:
+            rx = shm_ring.ShmRing.attach(payload["path"])
+        except (OSError, shm_ring.ShmRingError):
+            self._neg_send(conn, "confirm", ok=False)
+            self._teardown_shm(conn)
+            return
+        link.rx = rx
+        self._start_reader(link)  # gated: waits for the acceptor's cutover
+        self._flip_tx_live(conn, link.tx)
+        self._neg_send(conn, "confirm", ok=True)
+
+    def _shm_on_confirm(self, conn: int, payload: dict) -> None:
+        with self._lock:
+            link = self._shm_links.get(conn)
+        if link is None or link.addr is not None or link.rx is None:
+            return  # not an acceptor-side link: ignore
+        if not payload.get("ok"):
+            self._teardown_shm(conn)
+            return
+        self._flip_tx_live(conn, link.tx)
+
+    def _flip_tx_live(self, conn: int, ring: shm_ring.ShmRing) -> None:
+        """Enable the ring for sends AND put the cutover marker on the TCP
+        stream in one atomic act (vs this conn's data sends): after this, no
+        data frame follows the marker on TCP, so the peer's gated reader
+        starting at the marker preserves per-link FIFO exactly."""
+        with self._conn_lock(conn):
+            self._shm_tx_live[conn] = ring
+            self._neg_send(conn, "cutover")
+
+    def _start_reader(self, link: _ShmLink) -> None:
+        t = threading.Thread(
+            target=self._shm_reader, args=(link,),
+            name=f"shm-reader-{self.port}-{link.conn}", daemon=True,
+        )
+        link.reader = t
+        t.start()
+
+    def _shm_reader(self, link: _ShmLink) -> None:
+        """Drain one rx ring: zero-copy decode + the same dispatch path TCP
+        frames take.  Gated until the peer's cutover marker has passed the
+        dispatch thread; exits when the ring closes or the van shuts down."""
+        ring = link.rx
+        while not link.gate.is_set():
+            if self._closed.is_set() or ring.closed:
+                return
+            link.gate.wait(0.1)
+        while not self._closed.is_set():
+            if not ring.poll(0.1):
+                if ring.closed:
+                    return
+                continue
+            rec = ring.read()
+            if rec is None:
+                # poll() reports ready on a CLOSED ring too; a drained +
+                # closed ring means the peer is gone — exit (don't spin)
+                # so teardown's join() succeeds before it unmaps the ring.
+                if ring.closed:
+                    return
+                continue
+            idx, view = rec
+            # GC-anchored reclamation: every decoded array's base chain
+            # roots at this wrapper; the ring slot frees when the LAST view
+            # (numpy or CPU-jax alias) dies — see core/shm_ring.py.
+            wrapper = np.frombuffer(view, dtype=np.uint8)
+            weakref.finalize(wrapper, ring.release, idx)
+            with self._lock:
+                self.shm_frames_recv += 1
+                self.shm_bytes_recv += len(view)
+            self._dispatch_frame(wrapper, len(view), link.conn)
+            del wrapper, view, rec
+
+    def _teardown_shm(self, conn: int) -> None:
+        """Conn died (or negotiation failed): close both rings, stop the
+        reader, fall back to pure TCP.  Re-negotiated on reconnect."""
+        with self._lock:
+            link = self._shm_links.pop(conn, None)
+        if link is None:
+            return
+        with self._conn_lock(conn):
+            self._shm_tx_live.pop(conn, None)
+        with self._lock:
+            self._conn_locks.pop(conn, None)
+        for ring in (link.tx, link.rx):
+            if ring is not None:
+                ring.mark_closed()
+        link.gate.set()  # unblock a reader still waiting on the cutover
+        if link.reader is not None and link.reader is not threading.current_thread():
+            link.reader.join(timeout=5)
+        for ring in (link.tx, link.rx):
+            if ring is not None:
+                ring.close()
+
+    def drop_shm_links(self, *, disable: bool = False) -> int:
+        """Chaos/test hook: tear down every negotiated shm link (traffic
+        falls back to TCP mid-run, the same path a dying peer triggers).
+        ``disable=True`` also stops future negotiation, pinning the van to
+        pure TCP."""
+        if disable:
+            self.shm_enabled = False
+        with self._lock:
+            conns = list(self._shm_links)
+        for conn in conns:
+            self._teardown_shm(conn)
+        return len(conns)
 
     # -- receive -------------------------------------------------------------
     def _dispatch_loop(self) -> None:
@@ -310,81 +711,130 @@ class TcpVan(Van):
             if n == -3:
                 return
             if n == -2:
-                continue  # peer closed; routes stay (reconnect on send)
-            try:
-                raw = ctypes.string_at(data, n) if n else b""
-            finally:
-                self._lib.ps_van_free(data)
-            try:
-                msg = deserialize_message(memoryview(raw))
-            except FrameError as e:
-                # typed rejection (bad magic/version, header/meta/plane CRC
-                # mismatch, truncation): count it and keep the recv thread
-                # alive — wire noise reads as loss, repaired by the
-                # resender's retransmit, never as a dead transport
-                with self._lock:
-                    self.frame_rejects += 1
-                    self.dropped_messages += 1
-                flightrec.record(
-                    "frame.reject", reason="decode", nbytes=n,
-                    error=str(e)[:120],
-                )
-                logging.getLogger(__name__).debug(
-                    "tcpvan: rejecting %d-byte frame: %s", n, e
-                )
+                # peer closed; routes stay (reconnect on send), but any shm
+                # link anchored to the conn dies with it — that is the
+                # fallback path chaos/migration/restart rely on
+                self._teardown_shm(conn.value)
                 continue
-            except Exception:  # noqa: BLE001 — the codec's contract is that
-                # every decode failure is a FrameError, but this thread is a
-                # process-wide singleton: an exception type the codec missed
-                # must still read as one dropped frame, not dead reception
-                # for every node in the process
-                with self._lock:
-                    self.frame_rejects += 1
-                    self.dropped_messages += 1
-                flightrec.record(
-                    "frame.reject", reason="codec-bug", nbytes=n,
-                )
-                logging.getLogger(__name__).exception(
-                    "tcpvan: untyped decode failure on %d-byte frame "
-                    "(codec bug — dropping frame)", n
-                )
-                continue
-            if msg.sender:
-                with self._lock:
-                    self._peer_conns[msg.sender] = conn.value
-            try:
-                if self.filter_chain is not None:
-                    with self._lock:
-                        ll = self._link_locks.setdefault(
-                            (msg.sender, msg.recver), threading.Lock()
-                        )
-                    with ll:
-                        msg = self.filter_chain.decode(msg)
-            except Exception:  # noqa: BLE001 — one bad message must not kill
-                # the single dispatch thread (that would silently disable all
-                # reception for every node in this process)
-                logging.getLogger(__name__).exception(
-                    "tcpvan: dropping message for %r after filter-decode error",
-                    msg.recver,
-                )
-                with self._lock:
-                    self.dropped_messages += 1
-                continue
+            # Borrowed-buffer decode (no string_at copy): wrap the native
+            # malloc'd buffer, decode zero-copy views over it, and free it
+            # only when the last view dies (weakref.finalize -> ps_van_free).
+            addr = ctypes.cast(data, ctypes.c_void_p).value
+            carr = (ctypes.c_ubyte * n).from_address(addr)
+            wrapper = np.frombuffer(carr, dtype=np.uint8)
+            weakref.finalize(wrapper, _free_native, self._lib, addr)
+            self._dispatch_frame(wrapper, n, conn.value)
+            del wrapper, carr
+
+    def _dispatch_frame(self, buf, n: int, conn: Optional[int]) -> None:
+        """Decode one inbound frame and route it to its endpoint — shared by
+        the TCP dispatch loop and every shm ring reader."""
+        try:
+            msg = deserialize_message(buf)
+        except FrameError as e:
+            # typed rejection (bad magic/version, header/meta/plane CRC
+            # mismatch, truncation): count it and keep the recv thread
+            # alive — wire noise reads as loss, repaired by the
+            # resender's retransmit, never as a dead transport
             with self._lock:
-                ep = self._endpoints.get(msg.recver)
-            if ep is not None:
-                ep.inbox.put(msg)  # handler runs on the endpoint's own thread
+                self.frame_rejects += 1
+                self.dropped_messages += 1
+            flightrec.record(
+                "frame.reject", reason="decode", nbytes=n,
+                error=str(e)[:120],
+            )
+            logging.getLogger(__name__).debug(
+                "tcpvan: rejecting %d-byte frame: %s", n, e
+            )
+            return
+        except Exception:  # noqa: BLE001 — the codec's contract is that
+            # every decode failure is a FrameError, but this thread is a
+            # process-wide singleton: an exception type the codec missed
+            # must still read as one dropped frame, not dead reception
+            # for every node in the process
+            with self._lock:
+                self.frame_rejects += 1
+                self.dropped_messages += 1
+            flightrec.record("frame.reject", reason="codec-bug", nbytes=n)
+            logging.getLogger(__name__).exception(
+                "tcpvan: untyped decode failure on %d-byte frame "
+                "(codec bug — dropping frame)", n
+            )
+            return
+        if msg.task.customer == SHMNEG_CUSTOMER:
+            payload = msg.task.payload
+            op = payload.get("op") if isinstance(payload, dict) else None
+            if conn is not None:
+                self._shm_neg_dispatch(conn, op, payload)
+            return  # handshake traffic never reaches endpoints
+        if msg.sender and conn is not None:
+            with self._lock:
+                self._peer_conns[msg.sender] = conn
+        try:
+            if self.filter_chain is not None:
+                with self._lock:
+                    ll = self._link_locks.setdefault(
+                        (msg.sender, msg.recver), threading.Lock()
+                    )
+                with ll:
+                    msg = self.filter_chain.decode(msg)
+        except Exception:  # noqa: BLE001 — one bad message must not kill
+            # the single dispatch thread (that would silently disable all
+            # reception for every node in this process)
+            logging.getLogger(__name__).exception(
+                "tcpvan: dropping message for %r after filter-decode error",
+                msg.recver,
+            )
+            with self._lock:
+                self.dropped_messages += 1
+            return
+        with self._lock:
+            ep = self._endpoints.get(msg.recver)
+        if ep is not None:
+            ep.inbox.put(msg)  # handler runs on the endpoint's own thread
+
+    def _shm_neg_dispatch(self, conn: int, op, payload) -> None:
+        if op == "offer":
+            self._shm_on_offer(conn, payload)
+        elif op == "accept":
+            self._shm_on_accept(conn, payload)
+        elif op == "confirm":
+            self._shm_on_confirm(conn, payload)
+        elif op == "cutover":
+            with self._lock:
+                link = self._shm_links.get(conn)
+            if link is not None:
+                link.gate.set()
+        elif op == "nak":
+            self._teardown_shm(conn)
 
     # -- stats / lifecycle ---------------------------------------------------
     def counters(self) -> dict:
         with self._lock:
-            return {
+            tx_rings = [
+                l.tx for l in self._shm_links.values() if l.tx is not None
+            ]
+            c = {
                 "sent": self.sent_messages,
                 "dropped": self.dropped_messages,
                 "frame_rejects": self.frame_rejects,
                 "bytes_sent": self.bytes_sent(),
                 "bytes_recv": self.bytes_recv(),
+                "shm_links": len(self._shm_tx_live),
+                "shm_frames_sent": self.shm_frames_sent,
+                "shm_bytes_sent": self.shm_bytes_sent,
+                "shm_frames_recv": self.shm_frames_recv,
+                "shm_bytes_recv": self.shm_bytes_recv,
+                "ring_full": self.ring_fulls,
+                "writeq_full": self.writeq_fulls,
             }
+        for tx in tx_rings:
+            c["ring_full"] += tx.ring_full
+        if self._send_vec is not None and self._van:
+            c["writeq_full_native"] = int(
+                self._lib.ps_van_writeq_full(self._van)
+            )
+        return c
 
     def bytes_sent(self) -> int:
         van = self._van
@@ -394,12 +844,30 @@ class TcpVan(Van):
         van = self._van
         return int(self._lib.ps_van_bytes_recv(van)) if van else 0
 
+    # Payload egress/ingress regardless of medium: socket bytes PLUS frames
+    # that rode a colocated shm ring.  Byte-accounting flows (launch result
+    # JSON, bench plane-overlap arm) must use these — with shm negotiated,
+    # bytes_sent() alone reads near zero because data frames bypass the
+    # socket entirely, while wire filters still compress ring frames.
+    def payload_bytes_sent(self) -> int:
+        with self._lock:
+            return self.bytes_sent() + self.shm_bytes_sent
+
+    def payload_bytes_recv(self) -> int:
+        with self._lock:
+            return self.bytes_recv() + self.shm_bytes_recv
+
     def close(self) -> None:
         if self._closed.is_set():
             return
         # dispatch thread exits on its next timeout tick BEFORE the native
-        # handle is destroyed (it dereferences the handle in ps_van_recv)
+        # handle is destroyed (it dereferences the handle in ps_van_recv);
+        # shm readers exit on the same flag / their rings' closed marks
         self._closed.set()
+        with self._lock:
+            conns = list(self._shm_links)
+        for conn in conns:
+            self._teardown_shm(conn)
         self._dispatch.join(timeout=30)
         with self._lock:
             endpoints = list(self._endpoints.values())

@@ -161,8 +161,11 @@ def run_body(args) -> int:
                     {
                         "node": args.node_id,
                         "losses": losses,
-                        "wire_sent": van.bytes_sent(),
-                        "wire_recv": van.bytes_recv(),
+                        # socket + colocated-shm-ring bytes: the cross-
+                        # process traffic proof must not read zero just
+                        # because colocated links negotiated the fast path
+                        "wire_sent": van.payload_bytes_sent(),
+                        "wire_recv": van.payload_bytes_recv(),
                         "filter_overhead": (
                             chain.overhead() if chain is not None else None
                         ),

@@ -66,6 +66,18 @@ PR 6, nothing enforced:
    registry (:data:`REQUIRED_EVENTS`) — a registry edit that drops them
    would silence the device plane while every record call still "worked".
 
+8. **The shm fast path is copy-free.**  Transport v2's whole win
+   (ISSUE 17) is that a frame crosses a colocated link with ONE data
+   movement (the slice-assign into the shared mapping) and is decoded as
+   views in place on the other side.  A ``.tobytes()``, ``bytes(...)``
+   staging copy, or ``ctypes.string_at`` creeping into the registered
+   hot-path functions (:data:`SHM_COPY_FREE_FUNCS` in
+   ``core/shm_ring.py``, :data:`VAN_COPY_FREE_FUNCS` in
+   ``core/tcp_van.py`` — which also guards the borrowed-native-buffer
+   recv path) silently reintroduces the per-frame copy tax the ring
+   exists to kill.  Same loud-failure stance as the sync-free checks: a
+   registered function that disappears is itself a violation.
+
 Pure-AST check (no imports of the checked modules), so it runs in any
 environment and is wired as a tier-1 test (``tests/test_wrapper_contract.py``).
 Exit code 0 = clean; 1 = violations (one line each).
@@ -182,10 +194,33 @@ REQUIRED_EVENTS = frozenset({
     "ckpt.commit",
     "ckpt.restore",
     "ckpt.abort",
+    # transport v2 (ISSUE 17): shm-ring and epoll write-queue backpressure
+    # — dropping either would silence the fast path's only pressure signal
+    "net.ring_full",
+    "net.writeq_full",
 })
 
 #: ``np.<attr>`` calls that materialize a device array on the host.
 _SYNC_BANNED_NP = frozenset({"asarray", "array"})
+
+#: module holding the SPSC shared-memory ring (ISSUE 17), relative to the
+#: package root.
+SHM_RING_MODULE = "core/shm_ring.py"
+
+#: ``core/shm_ring.py`` functions on the per-frame fast path — writer
+#: (``write``: the ONE slice-assign into the mapping), reader
+#: (``poll``/``read``: zero-copy record views), and slot reclamation
+#: (``release``).  Copy-free by contract (:func:`check_copy_free`).
+SHM_COPY_FREE_FUNCS = frozenset({"write", "poll", "read", "release"})
+
+#: ``core/tcp_van.py`` functions on the per-frame fast path — the per-conn
+#: send choke point (ring write / vectored TCP), the ring reader, and the
+#: two receive-side functions that decode borrowed buffers in place.
+#: (``_wire_send_segs`` is deliberately NOT registered: its single-buffer
+#: fallback legitimately joins segments for the legacy ``ps_van_send``.)
+VAN_COPY_FREE_FUNCS = frozenset(
+    {"_send_on_conn", "_shm_reader", "_dispatch_loop", "_dispatch_frame"}
+)
 
 
 def _base_names(cls: ast.ClassDef) -> List[str]:
@@ -504,6 +539,61 @@ def check_push_ack_sync_free(
     return problems
 
 
+def check_copy_free(
+    path: pathlib.Path,
+    funcs_registry: frozenset,
+    registry_name: str,
+) -> List[str]:
+    """Ban per-frame copies inside the registered fast-path functions.
+
+    Flags ``.tobytes()`` calls, ``bytes(...)`` constructions, and
+    ``ctypes.string_at`` (module-qualified or bare) inside a
+    ``funcs_registry`` function.  A registry entry with no matching
+    function definition is ITSELF a violation — a rename must break this
+    check loudly, never let the contract pass vacuously against code it no
+    longer reads.
+    """
+    tree = ast.parse(path.read_text(), filename=str(path))
+    problems: List[str] = []
+    funcs = {}
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and node.name in funcs_registry
+        ):
+            funcs[node.name] = node
+    missing = sorted(funcs_registry - set(funcs))
+    if missing:
+        problems.append(
+            f"{_rel(path)}: copy-free fast-path functions missing: "
+            f"{missing} — renamed?  Update {registry_name} in "
+            "tools/check_wrappers.py so the contract keeps checking the "
+            "real hot path"
+        )
+    for name, fn in sorted(funcs.items()):
+        for call in _calls(fn):
+            f = call.func
+            label = None
+            if isinstance(f, ast.Attribute):
+                if f.attr == "tobytes":
+                    label = ".tobytes()"
+                elif f.attr == "string_at":
+                    label = "ctypes.string_at()"
+            elif isinstance(f, ast.Name):
+                if f.id == "bytes":
+                    label = "bytes()"
+                elif f.id == "string_at":
+                    label = "string_at()"
+            if label is not None:
+                problems.append(
+                    f"{_rel(path)}:{call.lineno}: {name} calls {label} — "
+                    "the shm/recv fast path is copy-free by contract "
+                    "(ISSUE 17: one slice-assign in, zero-copy views out); "
+                    "decode over the borrowed buffer instead"
+                )
+    return problems
+
+
 def check_control_verbs(
     path: pathlib.Path, verbs: frozenset, names: dict
 ) -> List[str]:
@@ -559,6 +649,8 @@ def main(argv: List[str]) -> int:
     found_hot_path = 0
     found_server = False
     found_ledger = False
+    found_shm_ring = False
+    found_tcp_van = False
     try:
         events = load_event_registry(PKG / FLIGHTREC_MODULE)
     except (OSError, ValueError) as e:
@@ -598,6 +690,16 @@ def main(argv: List[str]) -> int:
                         f, LEDGER_SYNC_FREE_FUNCS, "LEDGER_SYNC_FREE_FUNCS"
                     )
                 )
+            if rel == SHM_RING_MODULE:
+                found_shm_ring = True
+                problems.extend(
+                    check_copy_free(f, SHM_COPY_FREE_FUNCS, "SHM_COPY_FREE_FUNCS")
+                )
+            if rel == "core/tcp_van.py":
+                found_tcp_van = True
+                problems.extend(
+                    check_copy_free(f, VAN_COPY_FREE_FUNCS, "VAN_COPY_FREE_FUNCS")
+                )
             problems.extend(check_flightrec_calls(f, events))
             problems.extend(check_control_verbs(f, verbs, verb_names))
             text = f.read_text()
@@ -620,6 +722,15 @@ def main(argv: List[str]) -> int:
         # same vacuous-pass guard for the ledger's sync-free submit side
         print(
             "check_wrappers: kv/ledger.py not found — update LEDGER_MODULE",
+            file=sys.stderr,
+        )
+        return 1
+    if roots == [PKG] and not (found_shm_ring and found_tcp_van):
+        # the copy-free fast-path contract must not pass vacuously if
+        # either transport module moves
+        print(
+            "check_wrappers: shm/tcp transport module not found — update "
+            "SHM_RING_MODULE / the core/tcp_van.py hook",
             file=sys.stderr,
         )
         return 1
