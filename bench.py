@@ -4591,6 +4591,290 @@ def record_transport(record: dict, lines: list[str]) -> None:
     )
 
 
+# -- End-to-end tracing plane: sampled-request overhead (ISSUE 18) ---------
+
+_TRACEPLANE_BEGIN = "<!-- BENCH-TRACEPLANE:BEGIN -->"
+_TRACEPLANE_END = "<!-- BENCH-TRACEPLANE:END -->"
+
+#: acceptance: the headline sparse-LR loop with request tracing sampled at
+#: 1/_TRACEPLANE_SAMPLE_EVERY must hold throughput within
+#: _TRACEPLANE_TPUT_CEIL_PCT of the tracing-off run and add at most
+#: _TRACEPLANE_BYTES_CEIL_PCT wire bytes (the context rides only the
+#: sampled subset of frames, so at 1/1024 both should be noise-level).
+_TRACEPLANE_TPUT_CEIL_PCT = 3.0
+_TRACEPLANE_BYTES_CEIL_PCT = 1.0
+_TRACEPLANE_SAMPLE_EVERY = 1024
+_TRACEPLANE_WORKERS = 2
+_TRACEPLANE_SERVERS = 2
+_TRACEPLANE_BATCH = 2048
+_TRACEPLANE_NNZ = 26
+_TRACEPLANE_ROWS = 1 << 22
+_TRACEPLANE_DIM = 1
+_TRACEPLANE_WARMUP = 3
+_TRACEPLANE_STEPS = 20
+
+
+def _traceplane_arm(trace_cfg) -> dict:
+    """One seeded sparse-LR run over REAL TCP sockets (shm disabled so
+    every frame is byte-counted by the van), 2 workers x 2 servers.
+
+    Returns throughput over the timed steps, the wire bytes those steps
+    put on the sockets (both directions' sends), the sampled / closed
+    span-tree counts, and the final loss — the same workload for every
+    ``trace_cfg`` so the deltas are the tracing plane's own cost.
+    """
+    import jax.numpy as jnp
+
+    from parameter_server_tpu.config import (
+        OptimizerConfig, TableConfig, TransportConfig,
+    )
+    from parameter_server_tpu.core import flightrec
+    from parameter_server_tpu.core.postoffice import Postoffice
+    from parameter_server_tpu.core.tcp_van import TcpVan
+    from parameter_server_tpu.data.synthetic import SyntheticCTR
+    from parameter_server_tpu.kv.server import KVServer
+    from parameter_server_tpu.kv.worker import KVWorker
+    from parameter_server_tpu.models import linear
+
+    flightrec.configure(enabled=True, clear=True)
+    transport = TransportConfig(shm=False)
+    van_s = TcpVan(transport=transport)
+    # one van PER worker: the wire filters (key caching) keep per-link
+    # state, and two workers interleaving on a shared conn would make the
+    # byte counts scheduling-dependent — separate conns keep them exact
+    van_ws = [
+        TcpVan(transport=transport) for _ in range(_TRACEPLANE_WORKERS)
+    ]
+    cfgs = {
+        "w": TableConfig(
+            name="w", rows=_TRACEPLANE_ROWS, dim=_TRACEPLANE_DIM,
+            optimizer=OptimizerConfig(kind="sgd", learning_rate=0.1),
+        )
+    }
+    try:
+        for s in range(_TRACEPLANE_SERVERS):
+            KVServer(
+                Postoffice(f"S{s}", van_s), cfgs, s, _TRACEPLANE_SERVERS
+            )
+            for van_w in van_ws:
+                van_w.add_route(f"S{s}", van_s.address)
+        workers = [
+            KVWorker(
+                Postoffice(f"W{i}", van_w), cfgs, _TRACEPLANE_SERVERS,
+                trace=trace_cfg,
+            )
+            for i, van_w in enumerate(van_ws)
+        ]
+        data = SyntheticCTR(
+            key_space=_TRACEPLANE_ROWS, nnz=_TRACEPLANE_NNZ,
+            batch_size=_TRACEPLANE_BATCH, seed=5,
+        )
+        batches = [
+            data.next_batch()
+            for _ in range(_TRACEPLANE_WARMUP + _TRACEPLANE_STEPS)
+        ]
+        losses: list = [[] for _ in workers]
+        errors: list = []
+        barrier = threading.Barrier(_TRACEPLANE_WORKERS)
+
+        def _run(i, worker, phase_batches):
+            try:
+                for keys, labels in phase_batches:
+                    barrier.wait()
+                    w_pos = worker.pull_sync("w", keys, timeout=120)
+                    g, _gb, loss = linear.grad_rows(
+                        jnp.asarray(w_pos), jnp.asarray(labels)
+                    )
+                    worker.push_sync(
+                        "w", keys, np.asarray(g) / labels.shape[0],
+                        timeout=120,
+                    )
+                    losses[i].append(float(loss))
+            except Exception as e:  # noqa: BLE001 — surfaced to the arm
+                errors.append(e)
+                try:
+                    barrier.abort()
+                except Exception:  # noqa: BLE001
+                    pass
+
+        def _phase(phase_batches):
+            threads = [
+                threading.Thread(
+                    target=_run, args=(i, w, phase_batches), daemon=True
+                )
+                for i, w in enumerate(workers)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            if errors:
+                raise errors[0]
+
+        def _wire_bytes():
+            return sum(
+                int(v.counters()["bytes_sent"])
+                for v in [van_s, *van_ws]
+            )
+
+        _phase(batches[:_TRACEPLANE_WARMUP])
+        b0 = _wire_bytes()
+        t0 = time.perf_counter()
+        _phase(batches[_TRACEPLANE_WARMUP:])
+        elapsed = time.perf_counter() - t0
+        b1 = _wire_bytes()
+        return {
+            "examples_per_s": (
+                _TRACEPLANE_WORKERS * _TRACEPLANE_BATCH
+                * _TRACEPLANE_STEPS / elapsed
+            ),
+            "elapsed_s": elapsed,
+            "wire_bytes": b1 - b0,
+            "sampled": sum(w.trace_samples for w in workers),
+            "closed": sum(w.trace_closed for w in workers),
+            "final_loss": float(np.mean(losses[0][-5:])),
+        }
+    finally:
+        for van_w in van_ws:
+            van_w.close()
+        van_s.close()
+        flightrec.configure(enabled=True, clear=True)
+
+
+def run_traceplane() -> tuple[dict, list[str]]:
+    """ISSUE 18 acceptance arm: the SAME seeded 2-worker/2-server
+    sparse-LR job over TCP run tracing-off, sampled at
+    1/_TRACEPLANE_SAMPLE_EVERY (the default production knob), and fully
+    sampled (1/1, the worst case, informational) — reporting throughput
+    and wire-byte overhead of the sampled arm against the off arm."""
+    from parameter_server_tpu.config import TraceConfig
+
+    # throwaway arm: jax compile caches are process-global (same reasoning
+    # as run_hier) — the first arm would otherwise eat every compilation
+    _traceplane_arm(TraceConfig(enabled=False))
+    # interleaved best-of-N: a ~1 s CPU-bound timed phase sees several
+    # percent of scheduler/thermal drift between sequential runs — far
+    # more than the effect under test — so each config runs N times,
+    # round-robin, and scores its fastest run
+    cfg_of = {
+        "off": lambda: TraceConfig(enabled=False),
+        "on": lambda: TraceConfig(
+            sample_every=_TRACEPLANE_SAMPLE_EVERY, seed=0
+        ),
+        "full": lambda: TraceConfig(sample_every=1, seed=0),
+    }
+    runs: dict = {name: [] for name in cfg_of}
+    for _ in range(3):
+        for name, make in cfg_of.items():
+            runs[name].append(_traceplane_arm(make()))
+    best = {
+        name: max(rs, key=lambda a: a["examples_per_s"])
+        for name, rs in runs.items()
+    }
+    off, on, full = best["off"], best["on"], best["full"]
+    # a negative "overhead" is measurement noise (the sampled arm runs
+    # byte-identical code when 0 of its requests hash into the sample);
+    # clamp to 0 so the recorded series doesn't gate future runs against
+    # a spurious negative baseline
+    tput_pct = max(
+        0.0, 100.0 * (1.0 - on["examples_per_s"] / off["examples_per_s"])
+    )
+    bytes_pct = (
+        100.0 * (on["wire_bytes"] - off["wire_bytes"]) / off["wire_bytes"]
+    )
+    full_tput_pct = 100.0 * (
+        1.0 - full["examples_per_s"] / off["examples_per_s"]
+    )
+    loss_delta = abs(on["final_loss"] - off["final_loss"])
+    passed = (
+        tput_pct <= _TRACEPLANE_TPUT_CEIL_PCT
+        and bytes_pct <= _TRACEPLANE_BYTES_CEIL_PCT
+        # the full arm proves the plane is actually live in this workload
+        # (the 1/1024 arm legitimately samples ~0 of its ~160 requests)
+        and full["sampled"] > 0
+        and full["closed"] == full["sampled"]
+        and loss_delta == 0.0
+    )
+    lines = [
+        f"traceplane: 1/{_TRACEPLANE_SAMPLE_EVERY} sampling costs "
+        f"{tput_pct:+.2f}% throughput (ceiling "
+        f"{_TRACEPLANE_TPUT_CEIL_PCT}%) and {bytes_pct:+.3f}% wire bytes "
+        f"(ceiling {_TRACEPLANE_BYTES_CEIL_PCT}%)",
+        f"throughput: off {off['examples_per_s']:.0f} ex/s, sampled "
+        f"{on['examples_per_s']:.0f} ex/s, full-sampling "
+        f"{full['examples_per_s']:.0f} ex/s ({full_tput_pct:+.2f}%)",
+        f"span trees: sampled arm {on['sampled']} "
+        f"({on['closed']} closed), full arm {full['sampled']} "
+        f"({full['closed']} closed); loss delta {loss_delta:.1e}",
+        f"verdict: {'PASS' if passed else 'FAIL'}",
+    ]
+    record = {
+        "metric": "traceplane_overhead_pct",
+        "value": round(tput_pct, 2),
+        "unit": "%",
+        "vs_baseline": _TRACEPLANE_TPUT_CEIL_PCT,
+        "pass": passed,
+        "wire_bytes_overhead_pct": round(bytes_pct, 3),
+        "wire_bytes_ceiling_pct": _TRACEPLANE_BYTES_CEIL_PCT,
+        "full_sampling_overhead_pct": round(full_tput_pct, 2),
+        "loss_delta": float(f"{loss_delta:.1e}"),
+        "arms": {
+            name: {
+                "examples_per_s": round(a["examples_per_s"], 1),
+                "wire_kb": round(a["wire_bytes"] / 1e3, 1),
+                "sampled": int(a["sampled"]),
+                "closed": int(a["closed"]),
+                "final_loss": round(a["final_loss"], 4),
+            }
+            for name, a in (
+                ("off", off),
+                (f"1/{_TRACEPLANE_SAMPLE_EVERY}", on),
+                ("1/1", full),
+            )
+        },
+    }
+    return record, lines
+
+
+def record_traceplane(record: dict, lines: list[str]) -> None:
+    stamp = time.strftime("%Y-%m-%d %H:%M:%S UTC", time.gmtime())
+    rows = "".join(
+        f"| {name} | {a['examples_per_s']} | {a['wire_kb']} | "
+        f"{a['sampled']} | {a['closed']} | {a['final_loss']} |\n"
+        for name, a in record["arms"].items()
+    )
+    body = (
+        f"\n{stamp}; TCP cluster ({_TRACEPLANE_SERVERS} servers, "
+        f"{_TRACEPLANE_WORKERS} workers, shm off so every frame is "
+        f"byte-counted), host CPU only; headline sparse-LR shape: batch "
+        f"{_TRACEPLANE_BATCH}, {_TRACEPLANE_NNZ} slots/example, 2^22 rows "
+        f"x dim {_TRACEPLANE_DIM}, sgd; {_TRACEPLANE_STEPS} timed steps "
+        "per arm, barrier-locked.\n\n"
+        "| sampling | examples/s | wire KB | sampled | closed | "
+        "final loss (last 5) |\n|---|---|---|---|---|---|\n"
+        f"{rows}\n"
+        f"Throughput overhead: **{record['value']}%** against a "
+        f"{_TRACEPLANE_TPUT_CEIL_PCT}% ceiling; wire-byte overhead: "
+        f"**{record['wire_bytes_overhead_pct']}%** against a "
+        f"{_TRACEPLANE_BYTES_CEIL_PCT}% ceiling — "
+        f"{'PASS' if record['pass'] else 'FAIL'}.  The trace context "
+        "rides only the hash-sampled subset of PUSH/PULL frames "
+        "(unsampled requests carry zero trace bytes, asserted in "
+        "tests/test_traceplane.py), so the production 1/1024 knob is "
+        "noise-level on both axes; the 1/1 arm is the worst case — every "
+        "request journals its full span tree — and bounds what a "
+        "debugging session costs.  Losses are bitwise identical because "
+        "tracing never touches the value plane.\n"
+    )
+    _splice_baseline(
+        _TRACEPLANE_BEGIN,
+        _TRACEPLANE_END,
+        body,
+        "## End-to-end tracing: sampled-request overhead "
+        "(auto-recorded by bench.py --traceplane)",
+    )
+
+
 def emit_observability_artifacts(trace_dir: str) -> None:
     """``--trace-dir`` side artifacts beyond the bench's own phase trace:
     run a tiny 2-worker/2-server metered cluster and drop (a) per-node
@@ -5019,6 +5303,34 @@ def _dispatch() -> None:
         _emit(record)
         print("\n".join(lines), file=sys.stderr)
         record_hier(record, lines)
+        return
+    if "--traceplane" in sys.argv[1:]:
+        # host-side only: TCP cluster on CPU jax, no TPU probe
+        from parameter_server_tpu.utils.platform import force_cpu
+
+        force_cpu()
+        _start_watchdog("traceplane_overhead_pct", "%")
+        try:
+            record, lines = run_traceplane()
+        except Exception as e:  # noqa: BLE001 — the JSON line must still emit
+            _emit(
+                {
+                    "metric": "traceplane_overhead_pct",
+                    "value": 0.0,
+                    "unit": "%",
+                    "vs_baseline": _TRACEPLANE_TPUT_CEIL_PCT,
+                    "error": (
+                        f"traceplane failed: {type(e).__name__}: {e}"[:500]
+                    ),
+                }
+            )
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
+            return
+        _emit(record)
+        print("\n".join(lines), file=sys.stderr)
+        record_traceplane(record, lines)
         return
     if "--transport" in sys.argv[1:]:
         # host-side only: sockets + shm rings, no TPU probe, no jax

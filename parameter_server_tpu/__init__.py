@@ -37,4 +37,5 @@ from parameter_server_tpu.config import (  # noqa: F401
     OptimizerConfig,
     TableConfig,
     TopologyConfig,
+    TraceConfig,
 )

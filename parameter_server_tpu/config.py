@@ -437,3 +437,31 @@ class TableConfig:
     fused_apply: bool = True
     #: lossy wire codec for this table's PUSH plane; None = bit-exact wire.
     compression: Optional[WireCompressionConfig] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceConfig:
+    """Sampled end-to-end request tracing (ISSUE 18).
+
+    ``KVWorker`` consumes this to decide whether a PUSH/PULL submit stamps
+    a trace context (``core/tracectx.py``) into its payload.  Sampling is
+    a deterministic hash of ``(trace_id, seed)`` so seeded replays trace
+    the same requests and unsampled requests carry zero trace bytes on
+    the wire.
+    """
+
+    #: master switch; False stamps no contexts at all (the predicate the
+    #: hot path is gated behind — see tools/check_wrappers.py).
+    enabled: bool = True
+    #: trace 1-in-N requests.  1 = every request (tests), 0 = never;
+    #: 1024 is the default the bench gate holds to ≤3% overhead.
+    sample_every: int = 1024
+    #: seed folded into the sampling hash; replays with the same seed
+    #: sample the same trace ids.
+    seed: int = 0
+
+    def __post_init__(self) -> None:
+        if self.sample_every < 0:
+            raise ValueError(
+                f"sample_every must be >= 0, got {self.sample_every!r}"
+            )

@@ -61,6 +61,7 @@ import numpy as np
 
 from parameter_server_tpu.core import flightrec
 from parameter_server_tpu.core.messages import Message, Task, TaskKind
+from parameter_server_tpu.core.tracectx import TRACE_KEY, trace_ids
 from parameter_server_tpu.core.van import Van, VanWrapper
 
 logger = logging.getLogger(__name__)
@@ -316,6 +317,18 @@ class CoalescingVan(VanWrapper):
                 self._frames += 1
                 self._msgs += len(subs)
             frame = subs[0] if len(subs) == 1 else _pack(subs)
+            if len(subs) > 1:
+                # sampled request tracing (ISSUE 18): a bundle carries its
+                # sampled members' trace ids as an AGGREGATE context on
+                # the (fresh, _pack-owned) bundle payload, so the wire
+                # planes below see one trace key per frame; ``unbundle``
+                # fans the receive stamp back out to the member contexts.
+                # Bundles with no sampled member carry nothing.
+                tids = [
+                    t for s in subs for t in trace_ids(s.task.payload)
+                ]
+                if tids:
+                    frame.task.payload[TRACE_KEY] = {"tids": tids}
             if self.codec is not None:
                 encoded = self.codec.encode(frame)
             else:
@@ -404,6 +417,25 @@ class CoalescingVan(VanWrapper):
                     handler(msg)
                     return
                 subs = _unpack(msg)
+                bctx = msg.task.payload.get(TRACE_KEY)
+                if isinstance(bctx, dict):
+                    # sampled request tracing (ISSUE 18): fan the bundle's
+                    # receive stamp back out to its sampled members.  The
+                    # ``rx`` stamp only exists on wire paths, where every
+                    # member payload was freshly decoded — on a loopback
+                    # plane (shared dicts, no rx) nothing is mutated.
+                    rx = bctx.get("rx")
+                    if rx is not None:
+                        for sub in subs:
+                            sctx = sub.task.payload.get(TRACE_KEY)
+                            if isinstance(sctx, dict) and "rx" not in sctx:
+                                sctx["rx"] = rx
+                    flightrec.record(
+                        "trace.bundle",
+                        tids=trace_ids(msg.task.payload),
+                        sender=msg.sender,
+                        subs=len(subs),
+                    )
                 # grouped delivery: a Postoffice-bound handler takes the
                 # whole bundle at once so batchable customers (the server
                 # apply engine) see their members TOGETHER — one device

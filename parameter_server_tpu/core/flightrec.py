@@ -133,6 +133,26 @@ EVENTS = frozenset({
     "ckpt.commit",
     "ckpt.restore",
     "ckpt.abort",
+    # sampled request-tracing plane (ISSUE 18, core/tracectx.py): every
+    # kind below fires ONLY for hash-sampled requests (the gate
+    # tools/check_wrappers.py enforces).  submit = worker stamped a trace
+    # ctx and handed the request to the van; wire_tx/wire_rx = the frame
+    # crossed the per-conn choke point / was decoded off the wire (TCP or
+    # shm ring alike); bundle = a coalesced frame fanned its members'
+    # contexts back out; dispatch/reply = server handler entry / reply
+    # built (verdict ok|fenced); apply = ApplyLedger retired the bundle
+    # (host/h2d/device attribution); ack = the reply closed the span tree
+    # back on the worker (tools/postmortem.py anchors on its absence);
+    # retransmit = the resender re-sent a sampled frame
+    "trace.submit",
+    "trace.wire_tx",
+    "trace.wire_rx",
+    "trace.bundle",
+    "trace.dispatch",
+    "trace.reply",
+    "trace.apply",
+    "trace.ack",
+    "trace.retransmit",
 })
 
 #: env var: when set, recv-thread exceptions auto-dump a bundle here.

@@ -46,6 +46,17 @@ payload into header fields, :func:`decode` reinstates them, so every layer
 above the codec (resender dedup/fencing, routing fences, migration) sees
 bitwise-identical messages.  A stamp that is absent — or not a fixed-width
 int — simply stays in the meta section (flag unset).
+
+Sampled request tracing (ISSUE 18): a sampled request's trace context
+(``core/tracectx.py``, payload key ``__trace__``) is ordinary meta — a
+small dict of strings/floats the tag codec carries like any other payload
+entry, decoded into a FRESH dict on every receive (which is what lets the
+receiving van stamp its ``rx`` time into it without aliasing the sender's
+object).  Unsampled requests omit the key entirely: their frames are
+byte-identical to a tracing-off build (``frame_nbytes`` proves this in
+tests), and an all-int payload stays eligible for ``_fast_encode``'s
+cached-template path.  Old peers that predate the key simply decode and
+ignore it — plain meta, no version gate (MIGRATION.md).
 """
 
 from __future__ import annotations

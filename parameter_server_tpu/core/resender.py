@@ -75,6 +75,7 @@ import numpy as np
 
 from parameter_server_tpu.core import flightrec
 from parameter_server_tpu.core.frame import plane_view
+from parameter_server_tpu.core.tracectx import TRACE_KEY, trace_ids
 from parameter_server_tpu.core.messages import (
     INCARNATION_KEY,
     IncarnationRegistry,
@@ -405,6 +406,20 @@ class ReliableVan(VanWrapper):
                     "resend.retransmit", node=p.link[0],
                     recver=p.link[1], seq=p.seq, attempt=p.attempts,
                 )
+                payload = p.msg.task.payload
+                if isinstance(payload, dict) and TRACE_KEY in payload:
+                    # sampled request tracing (ISSUE 18): a sampled frame
+                    # (or a bundle carrying sampled members) going around
+                    # again — the context itself survives untouched
+                    # (``_STAMP_KEYS`` never strips it), this event just
+                    # makes the extra wire leg attributable
+                    flightrec.record(
+                        "trace.retransmit",
+                        tids=trace_ids(payload),
+                        recver=p.link[1],
+                        seq=p.seq,
+                        attempt=p.attempts,
+                    )
                 # send-time failure here is NOT fatal: the identity may be
                 # rebound (promotion) before the budget runs out
                 self.inner.send(p.msg)

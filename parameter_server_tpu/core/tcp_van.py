@@ -71,6 +71,7 @@ import logging
 import os
 import socket
 import threading
+import time
 import weakref
 from typing import Callable, Dict, Optional, Tuple
 
@@ -80,6 +81,7 @@ from parameter_server_tpu import native
 from parameter_server_tpu.config import TransportConfig
 from parameter_server_tpu.core import flightrec, frame, shm_ring
 from parameter_server_tpu.core.frame import FrameError
+from parameter_server_tpu.core.tracectx import TRACE_KEY, trace_ids
 from parameter_server_tpu.core.messages import Message, Task, TaskKind
 from parameter_server_tpu.core.van import Van, _Endpoint
 
@@ -454,6 +456,18 @@ class TcpVan(Van):
         resender retransmits) instead of degrading to TCP, because the
         degraded frame would arrive out of order and poison key-cache state.
         """
+        payload = msg.task.payload
+        if isinstance(payload, dict) and TRACE_KEY in payload:
+            # sampled request tracing (ISSUE 18): this is the per-conn
+            # choke point every outbound frame — ring OR TCP — passes, so
+            # one gated record covers both wire planes.  Unsampled frames
+            # (no trace key) cost the dict membership test only.
+            flightrec.record(
+                "trace.wire_tx",
+                tids=trace_ids(payload),
+                recver=msg.recver,
+                conn=conn,
+            )
         with self._conn_lock(conn):
             ring = self._shm_tx_live.get(conn)
             if ring is not None and not ring.closed:
@@ -788,6 +802,22 @@ class TcpVan(Van):
             with self._lock:
                 self.dropped_messages += 1
             return
+        payload = msg.task.payload
+        if isinstance(payload, dict):
+            tctx = payload.get(TRACE_KEY)
+            if isinstance(tctx, dict):
+                # sampled request tracing (ISSUE 18): stamp the receive
+                # time INTO the context — safe exactly here because this
+                # payload dict was freshly decoded off the wire (TCP and
+                # shm reader alike), never shared with a sender.  The
+                # server's queue attribution (trace.sq) is dispatch - rx.
+                tctx["rx"] = time.monotonic()
+                flightrec.record(
+                    "trace.wire_rx",
+                    tids=trace_ids(payload),
+                    sender=msg.sender,
+                    nbytes=n,
+                )
         with self._lock:
             ep = self._endpoints.get(msg.recver)
         if ep is not None:

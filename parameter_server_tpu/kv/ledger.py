@@ -69,10 +69,17 @@ class _Inflight:
 
     __slots__ = (
         "bundle", "table", "members", "rows",
-        "t_submit", "t_host", "t_h2d", "ref", "fallback",
+        "t_submit", "t_host", "t_h2d", "ref", "fallback", "tid",
     )
 
-    def __init__(self, bundle: int, table: str, members: int, rows: int):
+    def __init__(
+        self,
+        bundle: int,
+        table: str,
+        members: int,
+        rows: int,
+        tid: Optional[str] = None,
+    ):
         self.bundle = bundle
         self.table = table
         self.members = members
@@ -82,6 +89,10 @@ class _Inflight:
         self.t_h2d: Optional[float] = None
         self.ref = None
         self.fallback: Optional[Callable[[], object]] = None
+        #: sampled trace id (ISSUE 18): set when a sampled request rode
+        #: this apply — retirement then records a ``trace.apply`` child
+        #: span carrying the host/H2D/device split
+        self.tid = tid
 
     def mark_host(self) -> None:
         """Host plane assembly finished (the pinned-buffer pack)."""
@@ -137,13 +148,20 @@ class ApplyLedger:
         self._closed = False
 
     # -- submit side (recv thread; sync-free by AST contract) ---------------
-    def begin(self, table: str, members: int, rows: int) -> _Inflight:
+    def begin(
+        self,
+        table: str,
+        members: int,
+        rows: int,
+        tid: Optional[str] = None,
+    ) -> _Inflight:
         """Open an in-flight entry at dispatch start; returns the token the
-        apply path marks its split points on."""
+        apply path marks its split points on.  ``tid``: sampled trace id
+        riding this apply, if any (ISSUE 18)."""
         with self._lock:
             self._bundle_seq += 1
             seq = self._bundle_seq
-        return _Inflight(seq, table, members, rows)
+        return _Inflight(seq, table, members, rows, tid)
 
     def submit(
         self, tok: _Inflight, ref, fallback: Callable[[], object]
@@ -347,6 +365,20 @@ class ApplyLedger:
             host_ms=round(1e3 * host, 3), h2d_ms=round(1e3 * h2d, 3),
             device_ms=round(1e3 * dev, 3),
         )
+        if e.tid is not None:
+            # sampled request tracing (ISSUE 18): the device-plane child
+            # span — host pack / H2D / device execution attribution for
+            # the apply the sampled request rode
+            self._record(
+                "trace.apply",
+                tid=e.tid,
+                node=self.node_id,
+                table=e.table,
+                ms=round(1e3 * total, 3),
+                host_ms=round(1e3 * host, 3),
+                h2d_ms=round(1e3 * h2d, 3),
+                device_ms=round(1e3 * dev, 3),
+            )
 
     # -- telemetry-facing reads ----------------------------------------------
     def counters(self) -> dict:

@@ -41,6 +41,7 @@ from parameter_server_tpu.config import ApplyEngineConfig, LedgerConfig, TableCo
 from parameter_server_tpu.core import flightrec
 from parameter_server_tpu.core.messages import Message, Task, TaskKind
 from parameter_server_tpu.core.postoffice import Customer, Postoffice
+from parameter_server_tpu.core.tracectx import TRACE_KEY
 from parameter_server_tpu.kv.ledger import ApplyLedger
 from parameter_server_tpu.kv.partition import RangePartition
 from parameter_server_tpu.kv.routing import (
@@ -175,6 +176,18 @@ class KVServer(Customer):
             t: LatencyHistogram() for t in table_cfgs
         }
         self.fenced_rejects = 0
+        # -- sampled request tracing (ISSUE 18) ------------------------------
+        #: server-side plane attribution across sampled requests, exported
+        #: via :meth:`latency_digests`: ``trace.wire`` = worker submit ->
+        #: handler dispatch (same-host monotonic clocks; cross-host fleets
+        #: read the clock-rebased ``tools/critpath.py`` view instead),
+        #: ``trace.sq`` = van receive -> handler dispatch (server queue),
+        #: ``trace.apply`` = dispatch -> reply built.  Recv-thread-only,
+        #: same discipline as ``ro_hist``.
+        self._trace_hists: Dict[str, LatencyHistogram] = {}
+        #: tid -> dispatch monotonic time, bridging :meth:`_trace_dispatch`
+        #: to the reply site; bounded (error paths may never reply)
+        self._trace_disp: Dict[str, float] = {}
         self.rows_migrated_in = 0
         self.rows_migrated_out = 0
         self.migration_freeze_s = 0.0
@@ -307,6 +320,19 @@ class KVServer(Customer):
             FENCED_KEY: True,
             ROUTING_KEY: self.routing.to_payload(),
         }
+        tctx = msg.task.payload.get(TRACE_KEY)
+        if isinstance(tctx, dict) and tctx.get("tid") is not None:
+            # ISSUE 18: a fence is still a reply leg of the sampled span
+            # tree — echo the context (the fresh fence payload would drop
+            # it) so the worker closes the tree, and record the verdict
+            payload[TRACE_KEY] = tctx
+            self._trace_disp.pop(tctx["tid"], None)
+            flightrec.record(
+                "trace.reply",
+                tid=tctx["tid"],
+                node=self.post.node_id,
+                verdict="fenced",
+            )
         tname = msg.task.payload.get("table")
         if tname in self._seg_versions:
             payload["table"] = tname
@@ -327,7 +353,27 @@ class KVServer(Customer):
         a Loopback plane that dict IS the sender's object, so the stamp must
         replace the Task with a fresh payload, exactly as ``_fence_reply``
         does, never mutate in place.
+
+        Sampled request tracing (ISSUE 18): the request's ``__trace__``
+        context rides the copied payload back automatically, which is what
+        lets the worker close the span tree off this ack/reply; this is
+        also the one choke point every data reply passes, so the
+        ``trace.reply`` event and the dispatch → reply-built attribution
+        (``trace.apply``) are recorded here, gated on the sampled context.
         """
+        tctx = msg.task.payload.get(TRACE_KEY)
+        if isinstance(tctx, dict) and tctx.get("tid") is not None:
+            t_disp = self._trace_disp.pop(tctx["tid"], None)
+            if t_disp is not None:
+                self._trace_hist("trace.apply").record(
+                    max(time.monotonic() - t_disp, 0.0)
+                )
+            flightrec.record(
+                "trace.reply",
+                tid=tctx["tid"],
+                node=self.post.node_id,
+                verdict="ok",
+            )
         reply.task = dataclasses.replace(
             msg.task, payload={**msg.task.payload, VERSION_KEY: sver}
         )
@@ -441,9 +487,68 @@ class KVServer(Customer):
         for t, hist in self.ro_hist.items():
             if hist.count:
                 out[f"ro_pull.{t}"] = hist.to_dict()
+        # tracing plane (ISSUE 18): trace.wire / trace.sq / trace.apply —
+        # the series pstop's WIREµs/SQµs/APLY% columns and the
+        # ``trace-wire-p99`` SLO (utils/slo.py tracing_plane_specs) consume
+        for name, hist in self._trace_hists.items():
+            if hist.count:
+                out[name] = hist.to_dict()
         return out
 
     # -- request handling -----------------------------------------------------
+    @staticmethod
+    def _trace_tid_of(group: List[tuple]) -> Optional[str]:
+        """First sampled member's trace id of a batched push group — the
+        one the grouped apply's device attribution is charged to (pure
+        dict lookups: stays sync-free on the batched-apply path)."""
+        for _i, m, *_rest in group:
+            tctx = m.task.payload.get(TRACE_KEY)
+            if isinstance(tctx, dict) and tctx.get("tid") is not None:
+                return tctx["tid"]
+        return None
+
+    def _trace_hist(self, name: str) -> LatencyHistogram:
+        hist = self._trace_hists.get(name)
+        if hist is None:
+            hist = self._trace_hists[name] = LatencyHistogram()
+        return hist
+
+    def _trace_dispatch(self, msg: Message) -> None:
+        """Handler-entry attribution for a sampled request (ISSUE 18).
+
+        Gated on the request actually carrying a trace context — unsampled
+        requests (the vast majority) cost one dict lookup here, nothing
+        more (``tools/check_wrappers.py`` enforces the gate by AST).
+        Records the ``trace.dispatch`` event and feeds the live
+        wire/server-queue histograms from the context's origin/receive
+        stamps; the dispatch time is kept so the reply site can attribute
+        dispatch → reply-built into ``trace.apply``.
+        """
+        payload = msg.task.payload
+        tctx = payload.get(TRACE_KEY) if isinstance(payload, dict) else None
+        if isinstance(tctx, dict) and tctx.get("tid") is not None:
+            now = time.monotonic()
+            tid = tctx["tid"]
+            t0 = tctx.get("t")
+            rx = tctx.get("rx")
+            if rx is not None:
+                # wire transit proxy: origin submit -> van receive (the
+                # rx stamp exists only on wire paths — loopback degrades
+                # to no sample rather than a lie)
+                if t0 is not None:
+                    self._trace_hist("trace.wire").record(max(rx - t0, 0.0))
+                self._trace_hist("trace.sq").record(max(now - rx, 0.0))
+            while len(self._trace_disp) >= 1024:
+                self._trace_disp.pop(next(iter(self._trace_disp)))
+            self._trace_disp[tid] = now
+            flightrec.record(
+                "trace.dispatch",
+                tid=tid,
+                node=self.post.node_id,
+                op=msg.task.kind.name.lower(),
+                sender=msg.sender,
+            )
+
     def _span_attrs(self, msg: Message, tname: str) -> dict:
         # cross-node stitching: echo the worker's trace context onto this
         # handler's spans so merge_traces can pair both ends of the request
@@ -561,8 +666,16 @@ class KVServer(Customer):
         table = self.tables[tname]
         n = int(ids_np.shape[0])
         b = _bucket(n)
+        tctx = msg.task.payload.get(TRACE_KEY)
         tok = (
-            self.ledger.begin(tname, 1, n) if self.ledger is not None else None
+            self.ledger.begin(
+                tname,
+                1,
+                n,
+                tid=tctx.get("tid") if isinstance(tctx, dict) else None,
+            )
+            if self.ledger is not None
+            else None
         )
         ids_host = self._pad_ids(table, ids_np, b)
         if tok is not None:
@@ -685,6 +798,7 @@ class KVServer(Customer):
     def handle_request(self, msg: Message) -> Message:
         if msg.task.kind == TaskKind.CONTROL:
             return self._handle_control(msg)
+        self._trace_dispatch(msg)
         v = self._validate_data_request(msg)
         if isinstance(v, Message):
             return v
@@ -714,9 +828,12 @@ class KVServer(Customer):
         """Per-member failure reply, same shape the Postoffice emits for a
         raising single-request handler."""
         reply = msg.reply()
-        reply.task = dataclasses.replace(
-            msg.task, payload={"__error__": f"{type(exc).__name__}: {exc}"}
-        )
+        payload = {"__error__": f"{type(exc).__name__}: {exc}"}
+        tctx = msg.task.payload.get(TRACE_KEY)
+        if isinstance(tctx, dict):
+            # keep the sampled span tree closable even on a failed member
+            payload[TRACE_KEY] = tctx
+        reply.task = dataclasses.replace(msg.task, payload=payload)
         return reply
 
     def handle_request_batch(self, msgs: List[Message]) -> List[Message]:
@@ -778,6 +895,7 @@ class KVServer(Customer):
                     flush_group()
                     replies[i] = self._handle_control(msg)
                     continue
+                self._trace_dispatch(msg)
                 v = self._validate_data_request(msg)
                 if isinstance(v, Message):
                     flush_group()  # the fence observes prior writes too
@@ -871,7 +989,12 @@ class KVServer(Customer):
         k = len(group)
         bm = _bucket(max(int(g[3].shape[0]) for g in group))
         tok = (
-            self.ledger.begin(tname, k, sum(int(g[3].shape[0]) for g in group))
+            self.ledger.begin(
+                tname,
+                k,
+                sum(int(g[3].shape[0]) for g in group),
+                tid=self._trace_tid_of(group),
+            )
             if self.ledger is not None
             else None
         )

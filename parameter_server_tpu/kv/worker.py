@@ -44,9 +44,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from parameter_server_tpu.config import GroupConfig, TableConfig
+from parameter_server_tpu.config import GroupConfig, TableConfig, TraceConfig
 from parameter_server_tpu.core import flightrec
 from parameter_server_tpu.core.coalesce import GroupReducer
+from parameter_server_tpu.core.tracectx import TRACE_KEY, sampled
 from parameter_server_tpu.core.messages import Message, Task, TaskKind, server_id
 from parameter_server_tpu.core.postoffice import Customer, Postoffice
 from parameter_server_tpu.kv.cache import HotRowCache
@@ -90,6 +91,7 @@ class KVWorker(Customer):
         cache: Optional[HotRowCache] = None,
         group: Optional[WorkerGroup] = None,
         group_cfg: Optional[GroupConfig] = None,
+        trace: Optional[TraceConfig] = None,
     ) -> None:
         """``retry_on_timeout``: when a pull's deadline expires (dead or
         mid-promotion server), cancel the stuck task and re-issue it ONCE
@@ -143,6 +145,21 @@ class KVWorker(Customer):
         self.refresh_retries = 0
         #: cross-node trace ids (see :meth:`_trace_ctx`)
         self._trace_seq = itertools.count()
+        # -- sampled request tracing (ISSUE 18) ------------------------------
+        #: sampling policy; requests whose hashed id misses the 1-in-N
+        #: sample carry NO trace context (zero wire bytes)
+        self.trace = trace or TraceConfig()
+        self._trace_lock = threading.Lock()
+        #: tid -> [t0_mono, legs outstanding]; the span tree closes (and the
+        #: e2e latency records) when the last leg's ack returns.  Bounded:
+        #: oldest entries are evicted so a lost ack can never leak memory.
+        self._trace_pending: Dict[str, list] = {}
+        #: end-to-end request latency across sampled requests (submit ->
+        #: last ack), exported as ``trace.e2e`` via :meth:`latency_digests`
+        self._trace_e2e = LatencyHistogram()
+        #: sampled requests stamped / span trees closed (Dashboard-mergeable)
+        self.trace_samples = 0
+        self.trace_closed = 0
         # -- staleness observability (ISSUE 10) ------------------------------
         #: highest server version this worker's own pushes have been acked
         #: at, per (table, server) — the baseline update lag is measured from
@@ -277,6 +294,8 @@ class KVWorker(Customer):
             "refresh_retries": self.refresh_retries,
             "staleness_samples": self.staleness_samples,
             "busy_hints": self.busy_hints,
+            "trace_samples": self.trace_samples,
+            "trace_closed": self.trace_closed,
         }
         if self._group is not None:
             out.update(
@@ -317,6 +336,34 @@ class KVWorker(Customer):
         """
         try:
             payload = msg.task.payload
+            tctx = payload.get(TRACE_KEY)
+            if tctx is not None and isinstance(tctx, dict):
+                # sampled request tracing (ISSUE 18): the server echoed the
+                # context back on this ack/reply — this leg's return closes
+                # part of the span tree; the LAST leg records end-to-end
+                # latency and emits the closure event postmortem anchors on
+                tid = tctx.get("tid")
+                done = e2e = None
+                if tid is not None:
+                    with self._trace_lock:
+                        ent = self._trace_pending.get(tid)
+                        if ent is not None:
+                            ent[1] -= 1
+                            if ent[1] <= 0:
+                                self._trace_pending.pop(tid, None)
+                                e2e = time.monotonic() - ent[0]
+                                self._trace_e2e.record(max(e2e, 0.0))
+                                self.trace_closed += 1
+                                done = True
+                    if done:
+                        flightrec.record(
+                            "trace.ack",
+                            tid=tid,
+                            node=self.post.node_id,
+                            sender=msg.sender,
+                            fenced=bool(payload.get(FENCED_KEY)),
+                            e2e_ms=round(e2e * 1e3, 3),
+                        )
             if payload.get(BUSY_KEY):
                 # device-plane soft backpressure (ISSUE 12): the server's
                 # ApplyLedger backlog exceeded its bound when this ack was
@@ -383,6 +430,19 @@ class KVWorker(Customer):
         out.update(per_range)
         return out
 
+    def latency_digests(self) -> Dict[str, dict]:
+        """Tracing-plane digests for the telemetry publisher (ISSUE 18).
+
+        ``trace.e2e`` is submit → last-ack latency across sampled requests
+        — the denominator ``tools/critpath.py`` attributes into plane
+        segments.  Cumulative and monotone, same contract as the server's
+        :meth:`~parameter_server_tpu.kv.server.KVServer.latency_digests`.
+        """
+        with self._trace_lock:
+            if not self._trace_e2e.count:
+                return {}
+            return {"trace.e2e": self._trace_e2e.to_dict()}
+
     @staticmethod
     def _scan_fences(responses, order) -> Tuple[list, set, List[np.ndarray]]:
         """Split a completed task's responses into (data, fenced senders,
@@ -410,21 +470,60 @@ class KVWorker(Customer):
             if resp.task.payload.get(FENCED_KEY):
                 self.adopt_routing(resp.task.payload.get(ROUTING_KEY))
 
-    def _trace_ctx(self) -> dict:
-        """Fresh trace context for one logical request.
+    def _trace_ctx(self) -> Optional[dict]:
+        """Fresh trace context for one logical request — or ``None``.
 
-        Stamped into ``Task.payload["__trace__"]`` of every wire leg and
-        recorded as a ``trace`` attr on this worker's span; KVServer echoes
-        it onto its handler spans, so ``tools/merge_traces.py`` can line up
-        a worker's ``kv.push`` with the serving nodes' ``kv.server.push``
-        on one merged timeline.  The id is unique per (node, customer,
-        request) — no coordination needed across nodes.
+        ``None`` means the request missed the deterministic hash sample
+        (``core/tracectx.py``): no context is stamped, no ``__trace__``
+        payload key exists, zero trace bytes ride the wire, and the int-only
+        fast meta codec stays eligible.  A sampled request gets a dict
+        stamped into ``Task.payload["__trace__"]`` of every wire leg and
+        recorded as a ``trace`` attr on this worker's span; the receiving
+        van stamps ``rx``, the server adds dispatch/reply stamps and echoes
+        the context back on acks, so ``tools/merge_traces.py`` +
+        ``tools/critpath.py`` can stitch one cross-node timeline.  The id is
+        unique per (node, customer, request) — no coordination needed
+        across nodes, and the sampling decision is a pure function of
+        ``(tid, seed)`` so replays sample the same requests.
         """
+        tid = f"{self.post.node_id}/{self.name}/{next(self._trace_seq)}"
+        if not self.trace.enabled or not sampled(
+            tid, self.trace.seed, self.trace.sample_every
+        ):
+            return None
         return {
-            "tid": f"{self.post.node_id}/{self.name}/{next(self._trace_seq)}",
+            "tid": tid,
             "origin": self.post.node_id,
             "customer": self.name,
+            "t": time.monotonic(),
         }
+
+    def _trace_submitted(self, tctx: dict, op: str, legs: int) -> None:
+        """Bookkeep one sampled submit: ``legs`` acks close the span tree.
+
+        A ``None`` tctx (unsampled request) is a no-op — the whole body
+        sits behind the sampling gate, a contract ``tools/check_wrappers.py``
+        enforces statically (``TRACE_GATED_FUNCS``).
+        The pending map is bounded — the oldest entry is evicted when full,
+        so a reply that never returns (dead server past the resend budget)
+        degrades to a missing e2e sample, never to leaked memory.  The
+        orphan still shows in flightrec: ``trace.submit`` with no matching
+        ``trace.ack`` is exactly what ``tools/postmortem.py`` anchors on.
+        """
+        if tctx is not None:
+            with self._trace_lock:
+                self.trace_samples += 1
+                while len(self._trace_pending) >= 4096:
+                    self._trace_pending.pop(next(iter(self._trace_pending)))
+                self._trace_pending[tctx["tid"]] = [tctx["t"], int(legs)]
+            flightrec.record(
+                "trace.submit",
+                tid=tctx["tid"],
+                op=op,
+                node=self.post.node_id,
+                legs=int(legs),
+                t0_s=tctx["t"],
+            )
 
     # -- hierarchical push (ISSUE 15) ----------------------------------------
     def _group_push(
@@ -667,6 +766,9 @@ class KVWorker(Customer):
             "step": int(step),
             "ef": self._group_ef,
         }
+        # hierarchical hop: the LEADER stamps a fresh context for the
+        # reduced wire push — member contributions that fed it were local
+        # to the group, so the cross-node chain starts here
         tctx = self._trace_ctx()
         routing = self.routing
         keys = np.asarray(keys)
@@ -677,18 +779,16 @@ class KVWorker(Customer):
         for s, rel, ids in routing.slice_ids(table, sub):
             abs_pos = positions[rel]
             order[server_id(s)] = abs_pos
+            payload = {
+                "table": table,
+                ROUTING_EPOCH_KEY: routing.epoch,
+                GROUP_KEY: dict(stamp),
+            }
+            if tctx is not None:
+                payload[TRACE_KEY] = tctx
             msgs.append(
                 Message(
-                    task=Task(
-                        TaskKind.PUSH,
-                        self.name,
-                        payload={
-                            "table": table,
-                            "__trace__": tctx,
-                            ROUTING_EPOCH_KEY: routing.epoch,
-                            GROUP_KEY: dict(stamp),
-                        },
-                    ),
+                    task=Task(TaskKind.PUSH, self.name, payload=payload),
                     recver=server_id(s),
                     keys=ids.astype(np.int32),
                     values=[vals[abs_pos]],
@@ -698,6 +798,8 @@ class KVWorker(Customer):
             self._group_wire_done, table, step, keys, vals, fanin, attempt,
             order,
         )
+        # registered before the submit: the acks race the submit call
+        self._trace_submitted(tctx, "group_push", len(msgs))
         with self.coalesce_window():
             ts = self.submit(msgs, callback=cb)
         with self._group_lock:
@@ -910,7 +1012,7 @@ class KVWorker(Customer):
         ``positions`` (absolute indices into ``slots``, ascending) defaults
         to all of them; fence retries pass only the rejected subset.
         """
-        tctx = tctx or self._trace_ctx()
+        tctx = tctx if tctx is not None else self._trace_ctx()
         routing = self.routing  # one consistent table per submit
         if positions is None:
             positions = np.arange(slots.shape[0], dtype=np.int64)
@@ -919,27 +1021,30 @@ class KVWorker(Customer):
         for s, rel, ids in routing.slice_ids(table, sub):
             abs_pos = positions[rel]
             order[server_id(s)] = abs_pos
+            payload = {
+                "table": table,
+                ROUTING_EPOCH_KEY: routing.epoch,
+            }
+            if tctx is not None:
+                payload[TRACE_KEY] = tctx
             msgs.append(
                 Message(
-                    task=Task(
-                        TaskKind.PUSH,
-                        self.name,
-                        payload={
-                            "table": table,
-                            "__trace__": tctx,
-                            ROUTING_EPOCH_KEY: routing.epoch,
-                        },
-                    ),
+                    task=Task(TaskKind.PUSH, self.name, payload=payload),
                     recver=server_id(s),
                     keys=ids.astype(np.int32),
                     values=[combined[abs_pos]],
                 )
             )
+        # register the span tree BEFORE the wire submit: replies race the
+        # submit call (a fast peer can ack before submit() returns), and a
+        # decrement that finds no pending entry would leak an open tree
+        self._trace_submitted(tctx, "push", len(msgs))
         # window: under a CoalescingVan the burst flushes at submit
         # exit (no flush-timer latency); nested inside push_many's
         # window it coalesces across tables instead
         with self.coalesce_window():
-            return self.submit(msgs, keep_responses=keep), order
+            ts = self.submit(msgs, keep_responses=keep)
+        return ts, order
 
     def _prepare_push(self, table: str, keys, values):
         """Host half of a push: localize + device duplicate pre-combine."""
@@ -968,7 +1073,8 @@ class KVWorker(Customer):
         """
         tctx = self._trace_ctx()
         with self.tracer.span(
-            "kv.push", table=table, n=int(keys.size), trace=tctx["tid"]
+            "kv.push", table=table, n=int(keys.size),
+            **({"trace": tctx["tid"]} if tctx is not None else {}),
         ):
             slots, combined = self._prepare_push(table, keys, values)
             if self._group is not None:
@@ -990,7 +1096,8 @@ class KVWorker(Customer):
         """
         tctx = self._trace_ctx()
         with self.tracer.span(
-            "kv.push", table=table, n=int(keys.size), trace=tctx["tid"]
+            "kv.push", table=table, n=int(keys.size),
+            **({"trace": tctx["tid"]} if tctx is not None else {}),
         ):
             cfg = self.table_cfgs[table]
             vals = values.reshape(keys.size, cfg.dim)
@@ -1070,9 +1177,10 @@ class KVWorker(Customer):
         order = {}
         payload = {
             "table": table,
-            "__trace__": tctx,
             ROUTING_EPOCH_KEY: routing.epoch,
         }
+        if tctx is not None:
+            payload[TRACE_KEY] = tctx
         if read_only:
             payload[READ_ONLY_KEY] = True
         for s, rel, ids in routing.slice_ids(table, sub):
@@ -1087,6 +1195,8 @@ class KVWorker(Customer):
                     keys=ids.astype(np.int32),
                 )
             )
+        # registered before the submit: the replies race the submit call
+        self._trace_submitted(tctx, "pull", len(msgs))
         with self.coalesce_window():
             ts = self.submit(msgs, keep_responses=True)
         self._pull_plans[ts] = {
@@ -1097,7 +1207,7 @@ class KVWorker(Customer):
             "table": table,
             # retained so deadline/fence retries can re-issue subsets
             "slots": slots,
-            "trace": tctx["tid"],
+            "trace": tctx["tid"] if tctx is not None else None,
             "ro": read_only,
         }
         return ts

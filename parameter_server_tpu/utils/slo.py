@@ -457,6 +457,37 @@ def durability_plane_specs(
     ]
 
 
+def tracing_plane_specs(
+    *,
+    wire_p99_ms: float = 5.0,
+    window_s: float = 10.0,
+) -> List[SloSpec]:
+    """The ISSUE-18 tracing-plane SLO.
+
+    ``trace-wire-p99`` watches the windowed p99 of the ``trace.wire``
+    digest — worker submit stamp -> van receive for sampled requests,
+    the only direct cross-node wire-transit measurement the fleet has
+    (everything else folds queueing and apply in).  The server publishes
+    it through ``latency_digests()`` like every other latency series, so
+    the engine needs no new plumbing.  The digest only populates on real
+    wire transports (TCP/epoll or the shm ring; loopback never stamps a
+    receive), so in-process clusters simply report insufficient samples
+    rather than a vacuous pass/fail.  Breaching means the network plane
+    itself — not server queueing, not the device — is eating the request
+    budget: look at retransmits, backpressure instants (``net.*``), or
+    ``tools/critpath.py`` for the full per-plane split.
+    """
+    return [
+        SloSpec(
+            "trace-wire-p99",
+            "trace.wire",
+            wire_p99_ms,
+            source="p99",
+            window_s=window_s,
+        ),
+    ]
+
+
 def _delta_hist(first: dict, last: dict) -> LatencyHistogram:
     """Histogram of the samples recorded BETWEEN two cumulative digests.
 

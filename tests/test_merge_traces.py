@@ -11,7 +11,7 @@ import os
 import numpy as np
 import pytest
 
-from parameter_server_tpu.config import OptimizerConfig, TableConfig
+from parameter_server_tpu.config import OptimizerConfig, TableConfig, TraceConfig
 from parameter_server_tpu.core.postoffice import Postoffice
 from parameter_server_tpu.core.van import LoopbackVan
 from parameter_server_tpu.kv.server import KVServer
@@ -51,6 +51,7 @@ def _run_traced_cluster(tmp_path):
         worker = KVWorker(
             Postoffice("W0", van), cfgs, 2,
             min_bucket=16, tracer=tracers["W0"],
+            trace=TraceConfig(sample_every=1),
         )
         keys = np.arange(40, dtype=np.uint64)
         for _ in range(2):

@@ -2,7 +2,8 @@
 """bench_gate: the tier-1-adjacent perf-regression gate over BASELINE.md.
 
 ``bench.py``'s arms (``--wire``/``--obs``/``--apply``/``--devobs``/
-``--serve``/``--compress``/``--hier``/``--ckpt``) auto-record their headline numbers into marker blocks of
+``--serve``/``--compress``/``--hier``/``--ckpt``/``--transport``/
+``--traceplane``) auto-record their headline numbers into marker blocks of
 ``BASELINE.md``; ``tools/benchdiff.py`` can diff two revisions of that
 file cell-by-cell.  This tool closes the loop as a GATE a CI job (or a
 pre-commit hook) runs after re-benching:

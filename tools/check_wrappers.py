@@ -78,6 +78,19 @@ PR 6, nothing enforced:
    exists to kill.  Same loud-failure stance as the sync-free checks: a
    registered function that disappears is itself a violation.
 
+9. **Trace-span recording is gated behind the sampling predicate.**  The
+   request-tracing plane (ISSUE 18) promises ZERO per-message overhead
+   for unsampled traffic: a ``trace.*`` flightrec record (or the aliased
+   ``self._record("trace.*", ...)`` form) reached unconditionally on the
+   hot path would put a span allocation on every message at 1/1024
+   sampling.  Every registered hot-path function
+   (:data:`TRACE_GATED_FUNCS`) must emit its ``trace.*`` records under an
+   ``if`` — the sampling/context-presence gate — and a registered
+   function that stops recording any ``trace.*`` kind (refactored away)
+   is itself a violation (``check_trace_gated``).  The ``trace.*`` kinds
+   are pinned in :data:`REQUIRED_EVENTS` so a registry edit cannot
+   silence the plane.
+
 Pure-AST check (no imports of the checked modules), so it runs in any
 environment and is wired as a tier-1 test (``tests/test_wrapper_contract.py``).
 Exit code 0 = clean; 1 = violations (one line each).
@@ -198,10 +211,42 @@ REQUIRED_EVENTS = frozenset({
     # — dropping either would silence the fast path's only pressure signal
     "net.ring_full",
     "net.writeq_full",
+    # request tracing plane (ISSUE 18): the sampled span taxonomy —
+    # submit/dispatch/reply/apply/ack form the span tree critpath.py
+    # decomposes; wire_tx/wire_rx/bundle/retransmit are the transport
+    # hops merge_traces.py stitches into flow arrows.  Dropping any of
+    # these silently unstitches the cross-node timeline.
+    "trace.submit",
+    "trace.wire_tx",
+    "trace.wire_rx",
+    "trace.bundle",
+    "trace.dispatch",
+    "trace.reply",
+    "trace.apply",
+    "trace.ack",
+    "trace.retransmit",
 })
 
 #: ``np.<attr>`` calls that materialize a device array on the host.
 _SYNC_BANNED_NP = frozenset({"asarray", "array"})
+
+#: hot-path functions (module-relpath -> function names) whose ``trace.*``
+#: record sites must sit behind an ``if`` — the sampling / trace-context
+#: gate (ISSUE 18).  An unconditional record here would allocate a span
+#: per MESSAGE, not per sampled request; a registered function that stops
+#: recording any ``trace.*`` kind, or disappears, fails loudly
+#: (``check_trace_gated``).  ``unbundle`` is CoalescingVan's nested
+#: dispatch closure; the rest are methods.
+TRACE_GATED_FUNCS = {
+    "kv/worker.py": frozenset({"_trace_submitted", "_on_response"}),
+    "kv/server.py": frozenset(
+        {"_trace_dispatch", "_stamp_version", "_fence_reply"}
+    ),
+    "kv/ledger.py": frozenset({"_retire"}),
+    "core/tcp_van.py": frozenset({"_send_on_conn", "_dispatch_frame"}),
+    "core/coalesce.py": frozenset({"unbundle"}),
+    "core/resender.py": frozenset({"_retransmit_loop"}),
+}
 
 #: module holding the SPSC shared-memory ring (ISSUE 17), relative to the
 #: package root.
@@ -594,6 +639,100 @@ def check_copy_free(
     return problems
 
 
+def _trace_record_kind(call: ast.Call):
+    """Return the literal ``trace.*`` kind of a record-shaped ``call``.
+
+    Matches every recorder spelling used in the package — module
+    ``flightrec.record(...)``, method ``<expr>.record(...)`` and the
+    ledger's injected ``<expr>._record(...)``, plus bare ``record`` /
+    ``rec`` aliases — but only when the first argument is a literal
+    string starting with ``"trace."`` (so ``histogram.record(0.003)``
+    never false-positives).  Returns ``None`` otherwise.
+    """
+    f = call.func
+    shaped = (
+        (isinstance(f, ast.Attribute) and f.attr in ("record", "_record"))
+        or (
+            isinstance(f, ast.Name)
+            and f.id in (_RECORD_ALIASES | {"_record"})
+        )
+    )
+    if not shaped or not call.args:
+        return None
+    arg = call.args[0]
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        if arg.value.startswith("trace."):
+            return arg.value
+    return None
+
+
+def check_trace_gated(
+    path: pathlib.Path,
+    funcs_registry: frozenset,
+    registry_name: str = "TRACE_GATED_FUNCS",
+) -> List[str]:
+    """Require every ``trace.*`` record in a registered function to sit
+    under an ``if`` — the sampling / trace-context-presence gate.
+
+    The tracing plane's hot-path promise (ISSUE 18) is zero span
+    allocation for unsampled traffic; an unconditional record here turns
+    1/1024 sampling into per-message work.  Two loud-failure modes keep
+    the check honest: a registry entry with no matching function
+    definition (rename), and a registered function that records NO
+    ``trace.*`` kind at all (the instrumentation was refactored away but
+    the registry still claims it is checked).
+    """
+    tree = ast.parse(path.read_text(), filename=str(path))
+    problems: List[str] = []
+    funcs = {}
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and node.name in funcs_registry
+        ):
+            funcs[node.name] = node
+    missing = sorted(funcs_registry - set(funcs))
+    if missing:
+        problems.append(
+            f"{_rel(path)}: trace-gated functions missing: {missing} — "
+            f"renamed?  Update {registry_name} in tools/check_wrappers.py "
+            "so the contract keeps checking the real hot path"
+        )
+    for name, fn in sorted(funcs.items()):
+        parents = {}
+        for parent in ast.walk(fn):
+            for child in ast.iter_child_nodes(parent):
+                parents[child] = parent
+        recorded = 0
+        for call in _calls(fn):
+            kind = _trace_record_kind(call)
+            if kind is None:
+                continue
+            recorded += 1
+            node, gated = call, False
+            while node is not fn:
+                node = parents.get(node)
+                if node is None:
+                    break
+                if isinstance(node, ast.If):
+                    gated = True
+                    break
+            if not gated:
+                problems.append(
+                    f"{_rel(path)}:{call.lineno}: {name} records {kind!r} "
+                    "unconditionally — hot-path trace spans must be gated "
+                    "behind the sampling predicate (no per-message span "
+                    "allocation when unsampled)"
+                )
+        if not recorded:
+            problems.append(
+                f"{_rel(path)}:{fn.lineno}: {name} records no trace.* "
+                "events — instrumentation refactored away?  Update "
+                f"{registry_name} or restore the span record"
+            )
+    return problems
+
+
 def check_control_verbs(
     path: pathlib.Path, verbs: frozenset, names: dict
 ) -> List[str]:
@@ -651,6 +790,7 @@ def main(argv: List[str]) -> int:
     found_ledger = False
     found_shm_ring = False
     found_tcp_van = False
+    found_trace_gated = 0
     try:
         events = load_event_registry(PKG / FLIGHTREC_MODULE)
     except (OSError, ValueError) as e:
@@ -700,6 +840,9 @@ def main(argv: List[str]) -> int:
                 problems.extend(
                     check_copy_free(f, VAN_COPY_FREE_FUNCS, "VAN_COPY_FREE_FUNCS")
                 )
+            if rel in TRACE_GATED_FUNCS:
+                found_trace_gated += 1
+                problems.extend(check_trace_gated(f, TRACE_GATED_FUNCS[rel]))
             problems.extend(check_flightrec_calls(f, events))
             problems.extend(check_control_verbs(f, verbs, verb_names))
             text = f.read_text()
@@ -731,6 +874,16 @@ def main(argv: List[str]) -> int:
         print(
             "check_wrappers: shm/tcp transport module not found — update "
             "SHM_RING_MODULE / the core/tcp_van.py hook",
+            file=sys.stderr,
+        )
+        return 1
+    if roots == [PKG] and found_trace_gated != len(TRACE_GATED_FUNCS):
+        # the sampled-tracing gate contract must not pass vacuously if a
+        # traced hot-path module moves
+        print(
+            "check_wrappers: only "
+            f"{found_trace_gated}/{len(TRACE_GATED_FUNCS)} trace-gated "
+            "modules found — update TRACE_GATED_FUNCS",
             file=sys.stderr,
         )
         return 1

@@ -28,6 +28,17 @@ scheduler clock domain by subtracting the bundle's ``clock_offset_s``
 (the heartbeat min-RTT estimate), then shifted onto the merge's common
 epoch exactly like span ``ts`` values.
 
+Sampled request tracing (ISSUE 18) rides on both bridges.  After the
+merge, every group of "X" spans sharing an ``args.trace`` id across
+DIFFERENT pids is stitched with Perfetto *flow* events (``ph: "s"`` at
+the upstream span, ``ph: "f"``/``bp: "e"`` at the downstream one, flow id
+derived from the trace id) — one sampled request renders as a single
+cross-node arrow chain from the worker's submit span through each
+server's handler span.  Transport backpressure journal events
+(``net.ring_full`` / ``net.writeq_full``) are bridged with ``cat:
+"backpressure"`` so a stalled arrow can be read against the pressure
+instants that explain it.
+
 Usage::
 
     python tools/merge_traces.py -o merged.json trace_W0.json trace_S0.json ...
@@ -44,10 +55,16 @@ import argparse
 import json
 import os
 import sys
+import zlib
 from typing import Dict, List, Optional, Tuple
 
-#: ph values this tool understands (complete spans, metadata, instants).
-_KNOWN_PHASES = {"X", "M", "i"}
+#: ph values this tool understands (complete spans, metadata, instants,
+#: flow start/finish).
+_KNOWN_PHASES = {"X", "M", "i", "s", "f"}
+
+#: journal kinds bridged with ``cat: "backpressure"`` so transport-pressure
+#: instants are filterable against the request flow arrows they explain.
+_BACKPRESSURE_KINDS = {"net.ring_full", "net.writeq_full"}
 
 #: valid instant-event scopes ("g"lobal, "p"rocess, "t"hread).
 _INSTANT_SCOPES = {"g", "p", "t"}
@@ -83,16 +100,18 @@ def bundle_to_trace(doc: dict, fallback_node: str) -> Tuple[str, dict]:
             if k not in ("t_mono_s", "kind")
         }
         args.setdefault("node", node)
-        events.append(
-            {
-                "name": str(ev.get("kind") or "event"),
-                "ph": "i",
-                "s": "p",
-                "ts": (t_mono - mono) * 1e6,
-                "tid": 0,
-                "args": args,
-            }
-        )
+        kind = str(ev.get("kind") or "event")
+        inst = {
+            "name": kind,
+            "ph": "i",
+            "s": "p",
+            "ts": (t_mono - mono) * 1e6,
+            "tid": 0,
+            "args": args,
+        }
+        if kind in _BACKPRESSURE_KINDS:
+            inst["cat"] = "backpressure"
+        events.append(inst)
     return node, {
         "traceEvents": events,
         "metadata": {"node": node, "clock_t0_s": mono - off},
@@ -160,7 +179,44 @@ def merge_traces(
             if "ts" in ev:
                 ev["ts"] = ev["ts"] + shift_us
             events.append(ev)
+    events.extend(_stitch_flows(events))
     return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def _stitch_flows(events: List[dict]) -> List[dict]:
+    """Build Perfetto flow arrows between same-trace spans on different pids.
+
+    Spans sharing an ``args.trace`` id are sorted by rebased ``ts``; each
+    consecutive cross-pid pair gets a flow start (``ph: "s"``) bound to
+    the upstream span and a flow finish (``ph: "f"``, ``bp: "e"`` so it
+    binds to the ENCLOSING downstream slice) — rendering one sampled
+    request as a single arrow chain across node processes.  Flow ids are
+    ``crc32("<trace>:<hop>")``: deterministic, unique per hop, shared by
+    exactly its s/f pair.  Same-pid neighbours are skipped (no wire hop).
+    """
+    by_trace: Dict[str, List[dict]] = {}
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        trace = (ev.get("args") or {}).get("trace")
+        if trace:
+            by_trace.setdefault(str(trace), []).append(ev)
+    flows: List[dict] = []
+    for trace, spans in sorted(by_trace.items()):
+        spans.sort(key=lambda e: (e.get("ts", 0.0), e.get("pid", 0)))
+        hop = 0
+        for up, down in zip(spans, spans[1:]):
+            if up.get("pid") == down.get("pid"):
+                continue
+            fid = zlib.crc32(f"{trace}:{hop}".encode()) & 0xFFFFFFFF
+            common = {"name": "req", "cat": "traceflow", "id": fid,
+                      "args": {"trace": trace}}
+            flows.append(dict(common, ph="s", pid=up["pid"],
+                              tid=up.get("tid", 0), ts=up.get("ts", 0.0)))
+            flows.append(dict(common, ph="f", bp="e", pid=down["pid"],
+                              tid=down.get("tid", 0), ts=down.get("ts", 0.0)))
+            hop += 1
+    return flows
 
 
 def validate_chrome_trace(doc: dict) -> List[str]:
@@ -171,7 +227,9 @@ def validate_chrome_trace(doc: dict) -> List[str]:
     ("X") events also need numeric ``ts`` + non-negative ``dur`` and
     integer ``pid``/``tid``; instants ("i", the bridged flight-recorder
     events) need numeric ``ts``, integer ``tid``, and a valid scope when
-    ``s`` is present.
+    ``s`` is present; flow events ("s"/"f", the cross-node request
+    stitches) need numeric ``ts``, integer ``tid``, an ``id``, and — for
+    finishes — ``bp`` restricted to the enclosing-slice binding ("e").
     """
     problems: List[str] = []
     events = doc.get("traceEvents")
@@ -205,6 +263,15 @@ def validate_chrome_trace(doc: dict) -> List[str]:
                 problems.append(f"{where}: tid missing or not an int")
             if "s" in ev and ev["s"] not in _INSTANT_SCOPES:
                 problems.append(f"{where}: instant scope {ev['s']!r} invalid")
+        if ph in ("s", "f"):
+            if not isinstance(ev.get("ts"), (int, float)):
+                problems.append(f"{where}: ts missing or not numeric")
+            if not isinstance(ev.get("tid"), int):
+                problems.append(f"{where}: tid missing or not an int")
+            if not isinstance(ev.get("id"), (int, str)):
+                problems.append(f"{where}: flow event missing id")
+            if ph == "f" and "bp" in ev and ev["bp"] != "e":
+                problems.append(f"{where}: flow finish bp {ev['bp']!r} invalid")
         if "args" in ev and not isinstance(ev["args"], dict):
             problems.append(f"{where}: args not an object")
     return problems
@@ -230,9 +297,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         json.dump(merged, f)
     n_spans = sum(1 for e in merged["traceEvents"] if e.get("ph") == "X")
     n_inst = sum(1 for e in merged["traceEvents"] if e.get("ph") == "i")
+    n_flows = sum(1 for e in merged["traceEvents"] if e.get("ph") == "s")
     print(
         f"merged {len(args.traces)} node traces ({n_spans} spans, "
-        f"{n_inst} instants) -> {args.output}"
+        f"{n_inst} instants, {n_flows} flow arrows) -> {args.output}"
     )
     return 0
 

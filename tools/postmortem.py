@@ -105,6 +105,45 @@ def merge_bundles(paths: List[str]) -> dict:
     }
 
 
+def orphan_traces(merged: dict) -> List[dict]:
+    """Sampled requests whose span tree never closed (ISSUE 18).
+
+    A ``trace.submit`` with no matching ``trace.ack`` means the worker
+    never saw the last leg return — the request died somewhere between
+    the submit and the ack (dropped past the resend budget, dead server,
+    fenced-and-lost reply).  Each orphan is returned with its tid,
+    submitting node, rebased submit time, and the partial causal chain:
+    every merged trace event that mentions the tid (directly or inside a
+    bundle's ``tids`` list), in timeline order — exactly the events a
+    postmortem walks to see WHERE the request stopped.
+    """
+    events = merged["events"]
+    submits: Dict[str, dict] = {}
+    acked = set()
+    chains: Dict[str, List[dict]] = {}
+    for ev in events:
+        kind = ev.get("kind") or ""
+        if not kind.startswith("trace."):
+            continue
+        tids = ev.get("tids") or ([ev["tid"]] if ev.get("tid") else [])
+        for tid in tids:
+            chains.setdefault(tid, []).append(ev)
+        if kind == "trace.submit" and ev.get("tid"):
+            submits.setdefault(ev["tid"], ev)
+        elif kind == "trace.ack" and ev.get("tid"):
+            acked.add(ev["tid"])
+    return [
+        {
+            "tid": tid,
+            "node": sub.get("node"),
+            "t_s": sub["t_s"],
+            "chain": chains.get(tid, []),
+        }
+        for tid, sub in submits.items()
+        if tid not in acked
+    ]
+
+
 def first_anomaly(events: List[dict]) -> Optional[int]:
     """Index of the first anomalous event in a merged timeline, or None."""
     for i, ev in enumerate(events):
@@ -113,9 +152,29 @@ def first_anomaly(events: List[dict]) -> Optional[int]:
     return None
 
 
+def _row(ev: dict, t0: float) -> str:
+    extras = {
+        k: v for k, v in ev.items()
+        if k not in ("t_s", "t_mono_s", "seq", "kind", "node")
+    }
+    mark = "!" if ev.get("kind") in ANOMALY_KINDS else " "
+    detail = " ".join(f"{k}={v}" for k, v in extras.items())
+    return (
+        f" {mark} +{ev['t_s'] - t0:9.6f}s {str(ev['node']):>12s} "
+        f"{ev['kind']:<20s} {detail}".rstrip()
+    )
+
+
 def report(merged: dict, *, last: int = 30) -> List[str]:
     """Human-readable postmortem: the ``last`` events leading up to the
-    first anomaly, then everything from the anomaly on.  Returns lines."""
+    first anomaly, then everything from the anomaly on.  Returns lines.
+
+    An unclosed sampled span tree (ISSUE 18: ``trace.submit`` with no
+    ``trace.ack``) anchors the report exactly like a journaled anomaly —
+    its submit is the last confirmed sighting of a request that never
+    came back — and each orphan's partial causal chain is appended so
+    the reader sees WHICH hop the request died after.
+    """
     events = merged["events"]
     lines = [
         f"postmortem: {len(events)} events across "
@@ -124,29 +183,52 @@ def report(merged: dict, *, last: int = 30) -> List[str]:
     if not events:
         return lines + ["  (empty timeline)"]
     anom = first_anomaly(events)
-    if anom is None:
+    orphans = orphan_traces(merged)
+    o_first = None
+    if orphans:
+        idx = {id(e): i for i, e in enumerate(events)}
+        o_first = min(
+            (idx[id(o["chain"][0])] for o in orphans if o["chain"]),
+            default=None,
+        )
+    if anom is None and o_first is None:
         lines.append("no anomalies recorded; timeline tail:")
         window = events[-last:]
     else:
-        ev = events[anom]
-        lines.append(
-            f"first anomaly: [{anom}] {ev['kind']} on {ev['node']} "
-            f"at t={ev['t_s']:.6f}"
-        )
+        if anom is None or (o_first is not None and o_first < anom):
+            anchor = o_first
+            ev = events[anchor]
+            lines.append(
+                f"first anomaly: [{anchor}] unclosed span tree "
+                f"{(ev.get('tid') or (ev.get('tids') or ['?'])[0])} "
+                f"({ev['kind']} on {ev['node']} at t={ev['t_s']:.6f}, "
+                "no trace.ack ever followed)"
+            )
+        else:
+            anchor = anom
+            ev = events[anchor]
+            lines.append(
+                f"first anomaly: [{anchor}] {ev['kind']} on {ev['node']} "
+                f"at t={ev['t_s']:.6f}"
+            )
         lines.append(f"last {last} events before it, then the aftermath:")
-        window = events[max(0, anom - last):]
+        window = events[max(0, anchor - last):]
     t0 = window[0]["t_s"]
     for ev in window:
-        extras = {
-            k: v for k, v in ev.items()
-            if k not in ("t_s", "t_mono_s", "seq", "kind", "node")
-        }
-        mark = "!" if ev.get("kind") in ANOMALY_KINDS else " "
-        detail = " ".join(f"{k}={v}" for k, v in extras.items())
+        lines.append(_row(ev, t0))
+    if orphans:
         lines.append(
-            f" {mark} +{ev['t_s'] - t0:9.6f}s {str(ev['node']):>12s} "
-            f"{ev['kind']:<20s} {detail}".rstrip()
+            f"unclosed span trees: {len(orphans)} sampled request(s) "
+            "submitted but never acked"
         )
+        for o in orphans:
+            lines.append(
+                f"  trace {o['tid']} (submitted on {o['node']} at "
+                f"t={o['t_s']:.6f}) — partial causal chain:"
+            )
+            chain_t0 = o["chain"][0]["t_s"] if o["chain"] else o["t_s"]
+            for ev in o["chain"]:
+                lines.append(" " + _row(ev, chain_t0))
     return lines
 
 

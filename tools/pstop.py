@@ -31,6 +31,16 @@ Columns:
 - ``BKLG``      age of the oldest un-retired device apply, seconds;
 - ``APLYms``    p99 of the worst ``apply.*`` total-latency digest
                 (submit -> retire), milliseconds;
+- ``WIREus``    sampled-request wire-transit p99 (the ``trace.wire``
+                digest: worker submit stamp -> van receive, ISSUE 18),
+                microseconds — ``-`` until a sampled request crossed a
+                wire transport (loopback runs never populate it);
+- ``SQus``      server receive -> handler dispatch p99 (``trace.sq``),
+                microseconds — the server-queue plane of the same
+                sampled requests;
+- ``APLY%``     share of the apply plane in the traced server-side
+                p99 budget: ``trace.apply`` p99 over the sum of the
+                wire/server-queue/apply p99s, percent;
 - ``RO/S``      read-only fast-path pulls answered per second (servers)
                 — the serving plane's throughput column;
 - ``HIT%``      lifetime hot-row cache hit ratio (serving workers) —
@@ -75,7 +85,8 @@ _CLEAR = "\x1b[2J\x1b[H"
 _HEADER = (
     f"{'NODE':<10} {'SEQ':>5} {'AGE':>6} {'MSG/S':>8} {'KB/S':>9} "
     f"{'P99ms':>8} {'STALE p50/p99':>14} {'INF':>4} {'BKLG':>6} "
-    f"{'APLYms':>7} {'RO/S':>7} {'HIT%':>5} {'CMPR%':>6} {'GRP%':>6} "
+    f"{'APLYms':>7} {'WIREus':>7} {'SQus':>6} {'APLY%':>6} "
+    f"{'RO/S':>7} {'HIT%':>5} {'CMPR%':>6} {'GRP%':>6} "
     f"{'SHED/S':>7} {'CKPT':>6} "
     f"{'DRP':>4} {'MIG':>3} {'SLO':<18} FLAGS"
 )
@@ -138,6 +149,42 @@ def _apply_p99_ms(row: dict) -> Optional[float]:
         if worst is None or p99 > worst:
             worst = p99
     return None if worst is None else 1e3 * worst
+
+
+def _trace_p99_s(row: dict, name: str) -> Optional[float]:
+    """p99 of one tracing-plane digest (``trace.wire``/``trace.sq``/
+    ``trace.apply``), in seconds — None until the node has samples."""
+    digs = row.get("digests")
+    if not isinstance(digs, dict):
+        return None
+    s = digs.get(name)
+    if not isinstance(s, dict):
+        return None
+    p99 = s.get("p99")
+    return None if p99 is None else float(p99)
+
+
+def _trace_columns(row: dict):
+    """(wire_p99_us, sq_p99_us, apply_share_pct) for the traced planes.
+
+    The share is ``trace.apply`` p99 over the wire+queue+apply p99 sum —
+    "of the server-side budget a sampled request pays, how much is the
+    device apply" — and needs the apply digest present; absent planes
+    (loopback has no wire) contribute zero to the denominator.
+    """
+    wire = _trace_p99_s(row, "trace.wire")
+    sq = _trace_p99_s(row, "trace.sq")
+    apply_ = _trace_p99_s(row, "trace.apply")
+    share = None
+    if apply_ is not None:
+        denom = (wire or 0.0) + (sq or 0.0) + apply_
+        if denom > 0:
+            share = 100.0 * apply_ / denom
+    return (
+        None if wire is None else 1e6 * wire,
+        None if sq is None else 1e6 * sq,
+        share,
+    )
 
 
 def snapshot(latest: Dict[str, dict], now: Optional[float] = None) -> dict:
@@ -206,6 +253,9 @@ def render(latest: Dict[str, dict], now: Optional[float] = None) -> List[str]:
         inf = counters.get("inflight_bundles")
         bklg = counters.get("backlog_age_s")
         aply = _apply_p99_ms(row)
+        # tracing plane (ISSUE 18): sampled-request wire/queue p99s and
+        # the apply plane's share of the traced server-side budget
+        wire_us, sq_us, aply_pct = _trace_columns(row)
         # serving plane: rates derived by the aggregator per beat; the hit
         # ratio is lifetime-cumulative (see core/telemetry.py)
         ro_s = row.get("ro_per_s")
@@ -241,6 +291,9 @@ def render(latest: Dict[str, dict], now: Optional[float] = None) -> List[str]:
             f"{int(inf) if inf is not None else '-':>4} "
             f"{f'{bklg:.1f}' if bklg is not None else '-':>6} "
             f"{f'{aply:.1f}' if aply is not None else '-':>7} "
+            f"{f'{wire_us:.0f}' if wire_us is not None else '-':>7} "
+            f"{f'{sq_us:.0f}' if sq_us is not None else '-':>6} "
+            f"{f'{aply_pct:.1f}' if aply_pct is not None else '-':>6} "
             f"{f'{ro_s:.1f}' if ro_s is not None else '-':>7} "
             f"{f'{hitp:.1f}' if hitp is not None else '-':>5} "
             f"{f'{cmpr:.1f}' if cmpr is not None else '-':>6} "
