@@ -4875,6 +4875,126 @@ def record_traceplane(record: dict, lines: list[str]) -> None:
     )
 
 
+_WARGAME_BEGIN = "<!-- BENCH-WARGAME:BEGIN -->"
+_WARGAME_END = "<!-- BENCH-WARGAME:END -->"
+
+#: the seeded 50-node reference drill (flash crowd + gray failure +
+#: partition-then-heal); the arm runs it twice same-seed to prove the
+#: scorecard is bit-reproducible, then once autoscaler-off to prove the
+#: closed loop strictly reduces SLO-breach-minutes.
+_WARGAME_SEED = 0
+
+
+def run_wargame() -> tuple[dict, list[str]]:
+    from parameter_server_tpu.core import flightrec
+    from parameter_server_tpu.scenario import (
+        ScenarioRunner,
+        compile_schedule,
+        reference_scenario,
+        render_report,
+    )
+    from parameter_server_tpu.scenario.scorecard import scorecard_json
+
+    s = reference_scenario(_WARGAME_SEED)
+    sched_a = compile_schedule(s)
+    sched_b = compile_schedule(s)
+
+    def _arm(autoscale: bool):
+        flightrec.configure(clear=True)
+        runner = ScenarioRunner(s, autoscale=autoscale)
+        try:
+            card = runner.run()
+            report = render_report(runner, card) if autoscale else []
+            return card, report
+        finally:
+            runner.close()
+
+    card_on, report = _arm(autoscale=True)
+    card_on2, _ = _arm(autoscale=True)
+    card_off, _ = _arm(autoscale=False)
+    reproducible = (
+        sched_a == sched_b
+        and scorecard_json(card_on) == scorecard_json(card_on2)
+    )
+    on_min = card_on["slo"]["breach_minutes"]
+    off_min = card_off["slo"]["breach_minutes"]
+    passed = reproducible and on_min < off_min
+    lines = [
+        f"wargame: {s.name} seed {s.seed} — {s.nodes} nodes, "
+        f"{s.duration_s:.0f}s simulated, {len(sched_a)} scheduled events",
+        f"SLO-breach-minutes: autoscaler on {on_min:.2f}, "
+        f"off {off_min:.2f} (closed loop saves "
+        f"{off_min - on_min:.2f})",
+        f"bytes migrated: on {card_on['totals']['bytes_migrated']}, "
+        f"off {card_off['totals']['bytes_migrated']}; autoscaler actions: "
+        f"{len(card_on['autoscaler']['actions'])}",
+        f"scorecard bit-reproducible across same-seed runs: {reproducible}",
+        f"verdict: {'PASS' if passed else 'FAIL'}",
+    ]
+    record = {
+        "metric": "wargame_breach_minutes",
+        "value": round(on_min, 4),
+        "unit": "minutes",
+        "vs_baseline": round(off_min, 4),
+        "pass": passed,
+        "reproducible": reproducible,
+        "arms": {
+            name: {
+                "breach_minutes": c["slo"]["breach_minutes"],
+                "bytes_migrated": c["totals"]["bytes_migrated"],
+                "shed": c["totals"]["shed"],
+                "fence_rejects": c["totals"]["fence_rejects"],
+                "partition_dropped_frames": (
+                    c["totals"]["partition_dropped_frames"]
+                ),
+                "fleet_end": c["fleet"]["end"],
+                "actions": len(c["autoscaler"]["actions"]),
+            }
+            for name, c in (("on", card_on), ("off", card_off))
+        },
+        "report_lines": len(report),
+    }
+    return record, lines + ["", "incident report (autoscaler-on arm):"] + report
+
+
+def record_wargame(record: dict, lines: list[str]) -> None:
+    stamp = time.strftime("%Y-%m-%d %H:%M:%S UTC", time.gmtime())
+    rows = "".join(
+        f"| {name} | {a['breach_minutes']} | {a['bytes_migrated']} | "
+        f"{a['shed']} | {a['fence_rejects']} | "
+        f"{a['partition_dropped_frames']} | {a['fleet_end']} | "
+        f"{a['actions']} |\n"
+        for name, a in record["arms"].items()
+    )
+    body = (
+        f"\n{stamp}; seeded 50-node reference drill (seed {_WARGAME_SEED}: "
+        "flash crowd onto a shifted hot set + one gray slow_node + one "
+        "partition-then-heal), in-proc sim fleet over a seeded ChaosVan, "
+        "virtual clock, host CPU only.  Same-seed schedules and scorecard "
+        "JSON are byte-compared; the autoscaler arm closes the loop on "
+        "live telemetry.\n\n"
+        "| autoscaler | breach-minutes | bytes migrated | shed | "
+        "fence rejects | partition-dropped frames | fleet end | actions "
+        "|\n|---|---|---|---|---|---|---|---|\n"
+        f"{rows}\n"
+        f"SLO-breach-minutes with the autoscaler: "
+        f"**{record['value']}** vs **{record['vs_baseline']}** without — "
+        f"bit-reproducible: **{record['reproducible']}** — "
+        f"{'PASS' if record['pass'] else 'FAIL'}.  Breach-minutes and "
+        "bytes-migrated are lower-is-better in the benchdiff gate; the "
+        "full incident report (worst breach window + postmortem chain + "
+        "critpath attribution) prints on stderr of `bench.py --wargame` "
+        "and is exercised by tests/test_scenario.py.\n"
+    )
+    _splice_baseline(
+        _WARGAME_BEGIN,
+        _WARGAME_END,
+        body,
+        "## Fleet war games: SLO-breach-minutes under the reference drill "
+        "(auto-recorded by bench.py --wargame)",
+    )
+
+
 def emit_observability_artifacts(trace_dir: str) -> None:
     """``--trace-dir`` side artifacts beyond the bench's own phase trace:
     run a tiny 2-worker/2-server metered cluster and drop (a) per-node
@@ -5331,6 +5451,33 @@ def _dispatch() -> None:
         _emit(record)
         print("\n".join(lines), file=sys.stderr)
         record_traceplane(record, lines)
+        return
+    if "--wargame" in sys.argv[1:]:
+        # host-side only: in-proc sim fleet on a virtual clock, no TPU probe
+        from parameter_server_tpu.utils.platform import force_cpu
+
+        force_cpu()
+        _start_watchdog("wargame_breach_minutes", "minutes")
+        try:
+            record, lines = run_wargame()
+        except Exception as e:  # noqa: BLE001 — the JSON line must still emit
+            _emit(
+                {
+                    "metric": "wargame_breach_minutes",
+                    "value": 0.0,
+                    "unit": "minutes",
+                    "vs_baseline": None,
+                    "error": f"wargame failed: {type(e).__name__}: {e}"[:500],
+                }
+            )
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
+            return
+        _emit(record)
+        print("\n".join(lines), file=sys.stderr)
+        if record.get("pass"):
+            record_wargame(record, lines)
         return
     if "--transport" in sys.argv[1:]:
         # host-side only: sockets + shm rings, no TPU probe, no jax

@@ -153,6 +153,18 @@ EVENTS = frozenset({
     "trace.apply",
     "trace.ack",
     "trace.retransmit",
+    # war-game plane (ISSUE 19): begin/end bracket a scenario run; phase =
+    # a load phase became current; inject = a fault (gray failure,
+    # partition, restart wave) landed — an ANOMALY kind, so postmortems
+    # anchor on the injection that preceded the breach; heal = a fault was
+    # lifted; action = the autoscaler/runner acted (scale_up, drain_down,
+    # rebalance) on live telemetry
+    "scenario.begin",
+    "scenario.phase",
+    "scenario.inject",
+    "scenario.heal",
+    "scenario.action",
+    "scenario.end",
 })
 
 #: env var: when set, recv-thread exceptions auto-dump a bundle here.
@@ -447,4 +459,5 @@ def anomaly_kinds() -> frozenset:
         "serve.shed",
         "group.fallback",
         "ckpt.abort",
+        "scenario.inject",
     })

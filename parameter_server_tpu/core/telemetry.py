@@ -291,10 +291,36 @@ class TelemetryAggregator:
         fleet=None,
         jsonl_path: Optional[str] = None,
         rotate_bytes: int = 0,
+        config=None,
+        evaluate_scope: str = "fleet",
     ) -> None:
+        if evaluate_scope not in ("fleet", "node"):
+            raise ValueError(
+                f"evaluate_scope must be 'fleet' or 'node', "
+                f"got {evaluate_scope!r}"
+            )
+        from parameter_server_tpu.config import TelemetryConfig
+
         self.slo = slo
         self.fleet = fleet
-        self.window = window
+        # ring sizing scales with fleet size (ISSUE 19): ``config`` is the
+        # knob; a bare ``window=`` call synthesizes one that keeps the
+        # legacy fixed-window behaviour for small fleets but still bounds
+        # total retained rows once hundreds of publishers appear.
+        self.config = config if config is not None else TelemetryConfig(
+            window=window,
+            ring_budget_rows=max(8192, window),
+            min_window=min(8, window),
+        )
+        self.window = self.config.window
+        #: "fleet" re-evaluates every node per ingest (breach/clear edges
+        #: fire on ANY frame arrival — the live-cluster default); "node"
+        #: evaluates only the frame's sender, for 200-publisher fleets
+        #: where a per-ingest fleet sweep is O(fleet^2) per beat — the
+        #: war-game runner pairs it with one full sweep per tick.
+        self._evaluate_scope = evaluate_scope
+        #: current scenario phase (war-game plane); None outside a run.
+        self._phase: Optional[str] = None
         self._lock = threading.Lock()
         self._rings: Dict[str, collections.deque] = {}
         self._max_seq: Dict[str, int] = {}
@@ -428,7 +454,13 @@ class TelemetryAggregator:
             self.slo.ingest_counters(node, cum_snapshot, t_sched)
             for name, dig in slo_digests.items():
                 self.slo.observe(node, name, dig, t_sched)
-            self.slo.evaluate(now)
+            if self._evaluate_scope == "node":
+                try:
+                    self.slo.evaluate(now, nodes=[node])
+                except TypeError:  # engine predates the nodes= restriction
+                    self.slo.evaluate(now)
+            else:
+                self.slo.evaluate(now)
             healthy = self.slo.healthy(node)
             breaches = sorted(
                 name for (name, n), hit in self.slo._breached.items()
@@ -512,9 +544,16 @@ class TelemetryAggregator:
             row["straggler"] = flags
         row["counters"] = cum_snapshot
         with self._lock:
-            ring = self._rings.setdefault(
-                node, collections.deque(maxlen=self.window)
-            )
+            ring = self._rings.get(node)
+            if ring is None:
+                # a NEW publisher re-derives the fleet-wide per-node cap
+                # and re-caps existing rings in place, so the total stays
+                # near ``config.ring_budget_rows`` at any fleet size.
+                cap = self.config.node_window(len(self._rings) + 1)
+                if self._rings and next(iter(self._rings.values())).maxlen != cap:
+                    for n, r in self._rings.items():
+                        self._rings[n] = collections.deque(r, maxlen=cap)
+                ring = self._rings[node] = collections.deque(maxlen=cap)
             ring.append(row)
             # control-plane self-metrics (ISSUE 12): the aggregator's own
             # state rides every derived row, so ring pressure and dedup
@@ -522,12 +561,38 @@ class TelemetryAggregator:
             # side channel.  Occupancy is post-append: cap hit => eviction.
             row["ctl"] = {
                 "ring": len(ring),
-                "ring_cap": self.window,
+                "ring_cap": ring.maxlen,
                 "drops": self._drops.get(node, 0),
             }
+            # war-game extras ride only when the planes exist, so the ctl
+            # dict stays exactly the ISSUE-12 triple everywhere else.
+            if self._phase is not None:
+                row["ctl"]["phase"] = self._phase
+            if self.slo is not None and hasattr(self.slo, "breach_seconds"):
+                row["ctl"]["breach_min"] = round(
+                    self.slo.breach_seconds() / 60.0, 4
+                )
         if self.writer is not None:
             self.writer.write_line(json.dumps(row))
         return True
+
+    # -- war-game plane (ISSUE 19) --------------------------------------------
+    def set_phase(self, phase: Optional[str]) -> None:
+        """Stamp the live scenario phase onto subsequent ctl blocks (and
+        pstop's fleet footer).  ``None`` ends the run — ctl reverts to the
+        bare ISSUE-12 triple."""
+        self._phase = phase
+
+    @property
+    def phase(self) -> Optional[str]:
+        return self._phase
+
+    def breach_minutes(self) -> float:
+        """Running fleet-wide SLO-breach-minutes off the attached engine
+        (0.0 when no engine — or a pre-ISSUE-19 one — is attached)."""
+        if self.slo is None or not hasattr(self.slo, "breach_seconds"):
+            return 0.0
+        return self.slo.breach_seconds() / 60.0
 
     # -- reads ----------------------------------------------------------------
     def nodes(self) -> List[str]:

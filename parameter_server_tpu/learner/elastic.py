@@ -601,3 +601,165 @@ def restart_server(
         **server_kw,
     )
     return server, source, restarted.get("manager")
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscaleConfig:
+    """Closed-loop fleet sizing off live SLO verdicts (ISSUE 19).
+
+    The war-game runner ticks :class:`AutoscalePolicy` on its own clock
+    with the telemetry plane's current per-node health; the policy answers
+    with scale/heal intents.  Thresholds are fractions of the serving
+    fleet so the same config drives 8-node smokes and 200-node drills.
+    """
+
+    #: fleet size bounds the policy may steer between.
+    min_servers: int = 2
+    max_servers: int = 16
+    #: scale up when at least this fraction of servers is breaching ...
+    breach_frac_up: float = 0.25
+    #: ... for this many consecutive ticks (debounce single-sweep blips).
+    up_after_ticks: int = 2
+    #: drain down when the WHOLE fleet has been healthy this many ticks
+    #: and utilization headroom exists.
+    down_after_ticks: int = 10
+    #: per-server load (msgs/s) below which a healthy fleet is considered
+    #: overprovisioned; 0 disables drain-down on load.
+    drain_below_load: float = 0.0
+    #: fraction of the current fleet one scale_up adds (at least one
+    #: server) — a 50-node drill needs +10% steps, not +1 node, for added
+    #: capacity to outrun the load it is chasing.
+    step_frac: float = 0.1
+    #: seconds between ANY two actions — migrations must settle before the
+    #: controller reads their effect, or it oscillates.
+    cooldown_s: float = 30.0
+
+    def __post_init__(self) -> None:
+        if self.min_servers < 1:
+            raise ValueError(
+                f"min_servers must be >= 1, got {self.min_servers!r}"
+            )
+        if self.max_servers < self.min_servers:
+            raise ValueError(
+                f"max_servers ({self.max_servers!r}) must be >= "
+                f"min_servers ({self.min_servers!r})"
+            )
+        if not 0.0 < self.breach_frac_up <= 1.0:
+            raise ValueError(
+                f"breach_frac_up must be in (0, 1], got "
+                f"{self.breach_frac_up!r}"
+            )
+        if self.up_after_ticks < 1 or self.down_after_ticks < 1:
+            raise ValueError("*_after_ticks must be >= 1")
+        if self.step_frac <= 0.0:
+            raise ValueError(
+                f"step_frac must be > 0, got {self.step_frac!r}"
+            )
+        if self.cooldown_s < 0:
+            raise ValueError(
+                f"cooldown_s must be >= 0, got {self.cooldown_s!r}"
+            )
+
+
+class AutoscalePolicy:
+    """SLO-driven fleet sizing: telemetry verdicts in, scale intents out.
+
+    Pure control logic on an EXPLICIT clock — no wall time, no threads —
+    so the scenario runner can drive it deterministically in virtual time
+    and production can tick it from a monitor sweep.  Each ``tick`` takes
+    the current per-node view (``{node: {"healthy": bool, "load": float}}``)
+    and returns zero or more intents::
+
+        [{"kind": "scale_up", "count": 5}]           # add count servers
+        [{"kind": "drain_down", "node": "S3"}]       # retire the coldest
+        [{"kind": "rebalance", "node": "S1"}]        # shed the hottest
+
+    The caller owns execution (``scale_up``/``drain_down``/
+    ``RebalancePolicy`` in a live fleet, the simulated equivalents in a
+    war game) and reports the fleet size back on the next tick.  Every
+    decision lands in ``self.decisions`` for the scorecard.
+    """
+
+    def __init__(self, config: Optional[AutoscaleConfig] = None) -> None:
+        self.config = config or AutoscaleConfig()
+        self._breach_ticks = 0
+        self._healthy_ticks = 0
+        self._last_action_t: Optional[float] = None
+        #: decision log: {"t", "kind", "node"?, "reason"} per intent.
+        self.decisions: List[dict] = []
+
+    def _emit(self, now: float, kind: str, reason: str,
+              node: Optional[str] = None) -> dict:
+        intent = {"t": now, "kind": kind, "reason": reason}
+        if node is not None:
+            intent["node"] = node
+        self.decisions.append(intent)
+        self._last_action_t = now
+        return intent
+
+    def tick(self, now: float, view: Dict[str, dict]) -> List[dict]:
+        """One control sweep at virtual/real time ``now``.
+
+        ``view`` maps server node id -> ``{"healthy": bool, "load":
+        float}`` (load in msgs/s or any consistent per-node rate).
+        Returns the intents the caller should execute, possibly empty.
+        """
+        cfg = self.config
+        if not view:
+            return []
+        unhealthy = sorted(n for n, v in view.items() if not v.get("healthy", True))
+        frac = len(unhealthy) / len(view)
+        if unhealthy:
+            self._breach_ticks += 1
+            self._healthy_ticks = 0
+        else:
+            self._healthy_ticks += 1
+            self._breach_ticks = 0
+        in_cooldown = (
+            self._last_action_t is not None
+            and now - self._last_action_t < cfg.cooldown_s
+        )
+        if in_cooldown:
+            return []
+        intents: List[dict] = []
+        if (
+            frac >= cfg.breach_frac_up
+            and self._breach_ticks >= cfg.up_after_ticks
+        ):
+            if len(view) < cfg.max_servers:
+                count = min(
+                    max(1, int(len(view) * cfg.step_frac)),
+                    cfg.max_servers - len(view),
+                )
+                intent = self._emit(
+                    now, "scale_up",
+                    f"{len(unhealthy)}/{len(view)} breaching",
+                )
+                intent["count"] = count
+                intents.append(intent)
+            else:
+                # at the ceiling: shed the hottest breaching server's load
+                hottest = max(
+                    unhealthy, key=lambda n: view[n].get("load", 0.0)
+                )
+                intents.append(self._emit(
+                    now, "rebalance", "breaching at max_servers", hottest
+                ))
+            self._breach_ticks = 0
+        elif (
+            not unhealthy
+            and self._healthy_ticks >= cfg.down_after_ticks
+            and len(view) > cfg.min_servers
+            and cfg.drain_below_load > 0.0
+        ):
+            loads = {n: v.get("load", 0.0) for n, v in view.items()}
+            if max(loads.values()) < cfg.drain_below_load:
+                coldest = min(sorted(loads), key=lambda n: loads[n])
+                intents.append(self._emit(
+                    now, "drain_down",
+                    f"all healthy, peak load {max(loads.values()):.1f} < "
+                    f"{cfg.drain_below_load:.1f}",
+                    coldest,
+                ))
+                self._healthy_ticks = 0
+        return intents

@@ -82,6 +82,7 @@ class LoadGenerator:
         zipf_s: float = 1.1,
         seed: int = 0,
         cache=None,
+        rate_fn: Optional[Callable[[float], float]] = None,
     ) -> None:
         if num_keys <= 0:
             raise ValueError("num_keys must be positive")
@@ -93,6 +94,12 @@ class LoadGenerator:
             raise ValueError("aggregate rate must be positive")
         self.seed = int(seed)
         self.cache = cache
+        #: optional offered-load curve (ISSUE 19): a multiplier on the base
+        #: rate as a function of run time, so one generator can follow a
+        #: diurnal sine or a flash-crowd step instead of a flat rate.  The
+        #: inhomogeneous Poisson process is realized by thinning, so the
+        #: arrival stream stays seeded-deterministic for a fixed curve.
+        self.rate_fn = rate_fn
         rng = np.random.default_rng(self.seed)
         # Zipf pmf over ranks 1..num_keys, inverse-CDF sampled; ranks map
         # to key ids through a seeded permutation (hot keys spread across
@@ -102,10 +109,49 @@ class LoadGenerator:
         self._cdf = np.cumsum(pmf)
         self._rank_to_key = rng.permutation(num_keys).astype(np.int64)
 
+    def shift_hot_set(self, seed: int) -> None:
+        """Re-draw the rank -> key permutation (ISSUE 19 flash crowds).
+
+        The Zipf pmf over RANKS is unchanged; which concrete keys are hot
+        changes, which is exactly what a flash crowd does to a serving
+        cache — the hit-rate machinery has to re-learn the hot set.
+        Seeded, so scenario replays shift to the identical new hot set.
+        """
+        rng = np.random.default_rng(int(seed))
+        self._rank_to_key = rng.permutation(
+            self._rank_to_key.size
+        ).astype(np.int64)
+
     def _arrivals(self, rng, duration_s: float):
-        """Scheduled arrival offsets + per-request key batches."""
-        n = max(1, rng.poisson(self.qps * duration_s))
-        sched = np.sort(rng.random(n) * duration_s)
+        """Scheduled arrival offsets + per-request key batches.
+
+        With a ``rate_fn`` the arrivals follow the inhomogeneous Poisson
+        process ``qps * rate_fn(t)`` via thinning: draw a homogeneous
+        stream at the curve's peak rate, keep each arrival with
+        probability ``rate_fn(t)/peak``.  Same rng, fixed draw order —
+        deterministic for a fixed seed + curve.
+        """
+        if self.rate_fn is None:
+            n = max(1, rng.poisson(self.qps * duration_s))
+            sched = np.sort(rng.random(n) * duration_s)
+        else:
+            grid = np.linspace(0.0, duration_s, 1025)
+            mult = np.array([float(self.rate_fn(t)) for t in grid])
+            if np.any(mult < 0):
+                raise ValueError("rate_fn must be >= 0")
+            peak = float(mult.max())
+            if peak <= 0:
+                sched = np.zeros(1)
+            else:
+                n = max(1, rng.poisson(self.qps * peak * duration_s))
+                cand = np.sort(rng.random(n) * duration_s)
+                accept = rng.random(n) * peak <= np.array(
+                    [float(self.rate_fn(t)) for t in cand]
+                )
+                sched = cand[accept]
+                if sched.size == 0:
+                    sched = cand[:1]
+        n = sched.shape[0]
         u = rng.random((n, self.keys_per_pull))
         ranks = np.searchsorted(self._cdf, u, side="left")
         keys = self._rank_to_key[np.minimum(ranks, self._rank_to_key.size - 1)]

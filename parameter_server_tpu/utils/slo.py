@@ -145,6 +145,13 @@ class SloEngine:
         #: clamped forward so an out-of-order caller cannot shrink the
         #: window backwards and retro-flip an edge-triggered breach.
         self._last_now: Optional[float] = None
+        #: (spec name, node) -> engine time the CURRENT breach opened at.
+        self._breach_open: Dict[Tuple[str, str], float] = {}
+        #: (spec name, node) -> accumulated seconds over CLOSED breaches.
+        self._breach_acc_s: Dict[Tuple[str, str], float] = {}
+        #: closed breach intervals, in close order:
+        #: {"slo", "node", "t0", "t1"} — the scorecard's breach timeline.
+        self._breach_log: List[dict] = []
 
     # -- ingest --------------------------------------------------------------
     def observe(
@@ -253,18 +260,32 @@ class SloEngine:
             return None
         return spec.p99_scale * delta.percentile(0.99)
 
-    def evaluate(self, now: Optional[float] = None) -> Dict[str, SloVerdict]:
+    def evaluate(
+        self,
+        now: Optional[float] = None,
+        nodes: Optional[List[str]] = None,
+    ) -> Dict[str, SloVerdict]:
         """Per-node verdicts; edge-triggers breach/clear recorder events.
 
         ``now`` only moves forward: an evaluation stamped EARLIER than a
         previous one (a late telemetry frame re-triggering the sweep) is
         evaluated at the high-water clock, so an already-fired breach edge
         cannot retro-flip on stale time.
+
+        ``nodes`` restricts the sweep to a subset (the 200-publisher
+        war-game aggregator evaluates only the frame's sender per ingest
+        — O(specs) instead of O(fleet x specs) — and runs one full-fleet
+        sweep per runner tick).  Edge/interval state for unlisted nodes
+        is untouched.
         """
         now = time.monotonic() if now is None else now
         if self._last_now is not None and now < self._last_now:
             now = self._last_now
         self._last_now = now
+        sweep = (
+            sorted(self._nodes) if nodes is None
+            else sorted(self._nodes.intersection(nodes))
+        )
         # explicit None test: an EMPTY FlightRecorder is falsy (__len__ == 0),
         # and the first breach is exactly when the injected recorder is empty
         rec = (
@@ -272,7 +293,7 @@ class SloEngine:
             else self._recorder.record
         )
         out: Dict[str, SloVerdict] = {}
-        for node in sorted(self._nodes):
+        for node in sweep:
             breaches: Dict[str, Tuple[float, float]] = {}
             observed: Dict[str, float] = {}
             for spec in self.specs:
@@ -286,6 +307,10 @@ class SloEngine:
                 if is_breach:
                     breaches[spec.name] = (value, spec.max_value)
                 if is_breach and not was:
+                    # interval accounting opens on the same clamped clock
+                    # the edge fired at, so out-of-order re-evaluations can
+                    # neither reopen a closed interval nor shrink this one.
+                    self._breach_open[key] = now
                     rec(
                         "slo.breach",
                         node=node,
@@ -295,6 +320,14 @@ class SloEngine:
                         limit=spec.max_value,
                     )
                 elif was and not is_breach:
+                    t0 = self._breach_open.pop(key, now)
+                    dur = max(now - t0, 0.0)
+                    self._breach_acc_s[key] = (
+                        self._breach_acc_s.get(key, 0.0) + dur
+                    )
+                    self._breach_log.append(
+                        {"slo": spec.name, "node": node, "t0": t0, "t1": now}
+                    )
                     rec(
                         "slo.clear",
                         node=node,
@@ -319,6 +352,54 @@ class SloEngine:
             breached and name_node[1] == node
             for name_node, breached in self._breached.items()
         )
+
+    # -- breach-interval accounting ------------------------------------------
+    def breach_seconds(
+        self,
+        *,
+        node: Optional[str] = None,
+        spec: Optional[str] = None,
+        now: Optional[float] = None,
+    ) -> float:
+        """Total breached seconds, integrated from the edge-trigger stream.
+
+        Sums every CLOSED breach interval plus the open tail of any breach
+        still in flight, measured to ``now`` (clamped to the evaluate
+        high-water clock — the same forward-only time the edges fired on,
+        so a stale caller clock cannot shrink an open interval).  Filter by
+        ``node`` and/or ``spec`` name; divide by 60 for the scorecard's
+        SLO-breach-minutes.
+        """
+        if now is None:
+            now = self._last_now if self._last_now is not None else 0.0
+        elif self._last_now is not None and now < self._last_now:
+            now = self._last_now
+        total = 0.0
+        for (sname, n), acc in self._breach_acc_s.items():
+            if (node is None or n == node) and (spec is None or sname == spec):
+                total += acc
+        for (sname, n), t0 in self._breach_open.items():
+            if (node is None or n == node) and (spec is None or sname == spec):
+                total += max(now - t0, 0.0)
+        return total
+
+    def breach_timeline(self, now: Optional[float] = None) -> List[dict]:
+        """Every breach interval — closed ones verbatim, open ones extended
+        to ``now`` (high-water clamped) with ``"open": True`` — sorted by
+        start time.  This is the per-node × per-SLO timeline the war-game
+        scorecard integrates."""
+        if now is None:
+            now = self._last_now if self._last_now is not None else 0.0
+        elif self._last_now is not None and now < self._last_now:
+            now = self._last_now
+        out = [dict(iv) for iv in self._breach_log]
+        for (sname, n), t0 in self._breach_open.items():
+            out.append(
+                {"slo": sname, "node": n, "t0": t0,
+                 "t1": max(now, t0), "open": True}
+            )
+        out.sort(key=lambda iv: (iv["t0"], iv["node"], iv["slo"]))
+        return out
 
 
 def device_plane_specs(

@@ -519,3 +519,172 @@ def test_aggregator_ctl_self_metrics_ride_every_row():
                                recorder=flightrec.FlightRecorder(capacity=8))
     agg.ingest("S1", pub_b.frame(now=2.5), now=2.5)
     assert agg.rows("S1")[-1]["ctl"]["drops"] == 0
+
+
+# ------------------- ISSUE 19 satellites: fleet-scaled rings + breach math
+
+
+def test_telemetry_config_scales_ring_with_fleet_size():
+    from parameter_server_tpu.config import TelemetryConfig
+
+    cfg = TelemetryConfig(window=256, ring_budget_rows=8192, min_window=8)
+    assert cfg.node_window(1) == 256          # capped at the window
+    assert cfg.node_window(50) == 163         # 8192 // 50
+    assert cfg.node_window(200) == 40         # 8192 // 200
+    assert cfg.node_window(10_000) == 8       # floor wins
+    with pytest.raises(ValueError):
+        TelemetryConfig(window=0)
+    with pytest.raises(ValueError):
+        TelemetryConfig(window=16, min_window=32)
+    with pytest.raises(ValueError):
+        TelemetryConfig(window=64, ring_budget_rows=32)
+
+
+def test_aggregator_recaps_rings_and_never_dedup_drops_at_200_publishers():
+    """ISSUE 19 satellite: the per-node ring derives its capacity from the
+    fleet size (total row budget / publishers) so 200 honest publishers fit
+    the same memory envelope as 8 — and NONE of their frames are dropped as
+    duplicates (dedup drops stay zero; only the rings shrink)."""
+    from parameter_server_tpu.config import TelemetryConfig
+
+    cfg = TelemetryConfig(window=64, ring_budget_rows=1024, min_window=4)
+    agg = TelemetryAggregator(config=cfg)
+    nodes = [f"S{i}" for i in range(200)]
+    pubs = {
+        n: TelemetryPublisher(
+            n, None, recorder=flightrec.FlightRecorder(capacity=8)
+        )
+        for n in nodes
+    }
+    for beat in range(3):
+        for n in nodes:
+            assert agg.ingest(
+                n, pubs[n].frame(now=1.0 + beat), now=1.0 + beat
+            )
+    # zero dedup-drop growth: every honest frame landed
+    assert agg.counters()["telemetry_dup_frames"] == 0
+    assert all(
+        (r[-1]["ctl"]["drops"] == 0) for r in (agg.rows(n) for n in nodes)
+    )
+    # rings re-capped for the fleet: 1024 // 200 = 5 rows per node
+    caps = {agg.rows(n)[-1]["ctl"]["ring_cap"] for n in nodes}
+    assert caps == {cfg.node_window(200)} == {5}
+    total = sum(len(agg.rows(n)) for n in nodes)
+    assert total <= cfg.ring_budget_rows
+
+
+def test_breach_minutes_integrate_exactly_under_out_of_order_frames():
+    """ISSUE 19 satellite: edge-triggered breach/clear pairs integrate to
+    EXACT breach-minutes, and a late out-of-order frame (older digest, older
+    clock) neither shortens nor forks the open interval."""
+    eng = SloEngine([
+        SloSpec("stale", "staleness.w", 8.0, source="p99",
+                window_s=30.0, min_samples=1, p99_scale=1.0),
+    ])
+    d = _digests([20.0, 20.0, 1.0, 1.0])
+    eng.observe("W1", "staleness.w", d[0], now=100.0)
+    eng.observe("W1", "staleness.w", d[1], now=110.0)
+    eng.evaluate(now=110.0)                      # breach opens at 110
+    assert eng.breach_seconds(now=130.0) == pytest.approx(20.0)
+    # late frame: old digest, old clock — clamped, interval unchanged
+    eng.observe("W1", "staleness.w", d[0], now=95.0)
+    eng.evaluate(now=96.0)
+    assert eng.breach_seconds(now=130.0) == pytest.approx(20.0)
+    # healthy samples slide into the 30s window -> clear closes the interval
+    eng.observe("W1", "staleness.w", d[2], now=140.0)
+    eng.observe("W1", "staleness.w", d[3], now=150.0)
+    eng.evaluate(now=150.0)
+    assert eng.healthy("W1")
+    assert eng.breach_seconds() == pytest.approx(40.0)   # 110 -> 150
+    tl = eng.breach_timeline()
+    assert tl == [
+        {"slo": "stale", "node": "W1", "t0": 110.0, "t1": 150.0},
+    ]
+    # closed intervals do not keep growing
+    assert eng.breach_seconds(now=500.0) == pytest.approx(40.0)
+
+
+def test_breach_minutes_exact_under_nonzero_clock_offset():
+    """Frames from a node whose clock runs 5s ahead: the aggregator rebases
+    into the scheduler domain BEFORE the engine sees them, so the breach
+    interval — and hence breach-minutes — lands on scheduler time."""
+
+    class _Fleet:
+        def clock_offset(self, node):
+            return 5.0
+
+        def stragglers(self, now):
+            return {}
+
+    eng = SloEngine([
+        SloSpec("stale", "staleness.w", 8.0, source="p99",
+                window_s=60.0, min_samples=1, p99_scale=1.0),
+    ])
+    agg = TelemetryAggregator(slo=eng, fleet=_Fleet())
+    d = _digests([20.0, 20.0])
+    agg.ingest("W0", {
+        "seq": 1, "t_mono_s": 105.0,
+        "staleness": {"staleness.w": d[0]},
+    }, now=100.0)
+    agg.ingest("W0", {
+        "seq": 2, "t_mono_s": 115.0,
+        "staleness": {"staleness.w": delta_digest(d[0], d[1]) or {}},
+    }, now=110.0)
+    assert not eng.healthy("W0")
+    # interval opened at the REBASED stamp (110), not the node's 115
+    assert eng.breach_seconds(now=140.0) == pytest.approx(30.0)
+    tl = eng.breach_timeline(now=140.0)
+    assert tl == [
+        {"slo": "stale", "node": "W0", "t0": 110.0, "t1": 140.0,
+         "open": True},
+    ]
+
+
+def test_restricted_evaluate_sweeps_only_named_nodes():
+    eng = SloEngine([
+        SloSpec("g", "lag", 10.0, window_s=100.0, min_samples=1),
+    ])
+    eng.observe("W0", "lag", 50.0, now=5.0)
+    eng.observe("W1", "lag", 50.0, now=5.0)
+    verdicts = eng.evaluate(now=6.0, nodes=["W0"])
+    assert set(verdicts) == {"W0"}
+    assert not eng.healthy("W0")
+    assert eng.healthy("W1")  # untouched by the restricted sweep
+    # the full sweep still covers everyone
+    assert set(eng.evaluate(now=7.0)) == {"W0", "W1"}
+    assert not eng.healthy("W1")
+
+
+def test_pstop_fleet_summary_footer_rolls_up_the_fleet():
+    """ISSUE 19 satellite: one footer row carries aggregate MSG/S, the worst
+    node's staleness p99, running breach-minutes and the scenario phase."""
+    latest = {
+        "S0": {
+            "seq": 3, "t_ingest": 10.0, "msgs_per_s": 12.5,
+            "staleness": {"w": {"p50": 1.0, "p99": 4.0}},
+            "ctl": {"ring": 1, "ring_cap": 8, "drops": 0,
+                    "phase": "warmup", "breach_min": 0.1},
+        },
+        "S1": {
+            "seq": 4, "t_ingest": 11.0, "msgs_per_s": 7.5,
+            "staleness": {"w": {"p50": 2.0, "p99": 9.0}},
+            "ctl": {"ring": 1, "ring_cap": 8, "drops": 0,
+                    "phase": "flash_crowd", "breach_min": 0.25},
+        },
+    }
+    fleet = pstop.fleet_summary(latest)
+    assert fleet == {
+        "msgs_per_s": 20.0, "worst_stale_p99": 9.0,
+        "breach_minutes": 0.25, "phase": "flash_crowd",  # freshest row wins
+    }
+    out = "\n".join(pstop.render(latest, now=11.0))
+    assert "== FLEET" in out and "MSG/S=20.0" in out
+    assert "breach-min=0.25" in out and "phase=flash_crowd" in out
+    snap = pstop.snapshot(latest, now=11.0)
+    assert snap["fleet"]["phase"] == "flash_crowd"
+    # no scenario, no slo: the footer degrades to dashes, not crashes
+    bare = pstop.fleet_summary({"S0": {"seq": 1, "t_ingest": 1.0}})
+    assert bare == {
+        "msgs_per_s": None, "worst_stale_p99": None,
+        "breach_minutes": None, "phase": None,
+    }

@@ -155,6 +155,13 @@ def _tcp_run_bytes(trace_cfg):
             Postoffice("W0", van_w), cfgs, 1, trace=trace_cfg
         )
         _train(worker, _batches()[:4])
+        # the server's send counters land on its event-loop thread, which
+        # can trail the worker's last synchronous ack by a beat — settle
+        # both vans (4 pulls + 4 pushes each way) before reading bytes
+        assert _wait_for(
+            lambda: van_w.counters()["sent"] >= 8
+            and van_s.counters()["sent"] >= 8
+        )
         n_trace = sum(
             1 for e in flightrec.get().events()
             if str(e.get("kind", "")).startswith("trace.")

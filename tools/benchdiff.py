@@ -38,8 +38,11 @@ from typing import Dict, List, Optional, Tuple
 
 #: metric-name/unit fragments marking higher-is-better series.
 _HIGHER = ("throughput", "/s", "per_s", "speedup", "examples", "rows_per")
-#: fragments marking lower-is-better series.
-_LOWER = ("ms", "us", "latency", "overhead", "pct", "%", "seconds", "bytes")
+#: fragments marking lower-is-better series.  ``minutes``/``breach``/
+#: ``migrated`` cover the war-game scorecard (SLO-breach-minutes,
+#: bytes-migrated) — less downtime and less data moved are both wins.
+_LOWER = ("ms", "us", "latency", "overhead", "pct", "%", "seconds", "bytes",
+          "minutes", "breach", "migrated")
 
 _MARKER = re.compile(r"<!--\s*BENCH-([A-Z0-9_]+):BEGIN\s*-->")
 _NUM = re.compile(r"-?\d+(?:,\d{3})*(?:\.\d+)?")

@@ -225,6 +225,15 @@ REQUIRED_EVENTS = frozenset({
     "trace.apply",
     "trace.ack",
     "trace.retransmit",
+    # war-game plane (ISSUE 19): the scenario runner's schedule must leave
+    # a reconstructable trail — begin/phase/inject/heal/action/end — or
+    # the scorecard's incident report loses its causal anchors.
+    "scenario.begin",
+    "scenario.phase",
+    "scenario.inject",
+    "scenario.heal",
+    "scenario.action",
+    "scenario.end",
 })
 
 #: ``np.<attr>`` calls that materialize a device array on the host.

@@ -57,6 +57,7 @@ ANOMALY_KINDS = frozenset({
     "serve.shed",
     "group.fallback",
     "ckpt.abort",
+    "scenario.inject",
 })
 
 

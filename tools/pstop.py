@@ -61,6 +61,12 @@ Columns:
 - ``SLO``       ``ok`` / ``BREACH:<spec,...>`` from the live engine;
 - ``FLAGS``     FleetMonitor straggler flags (``latency``, ``gap``).
 
+Below the table a ``== FLEET ... ==`` footer rolls the whole fleet into
+one row — aggregate MSG/S, the worst node's staleness p99, the running
+SLO-breach-minutes and the current war-game scenario phase (the last two
+ride each row's ``ctl`` block when a scenario is active) — so 200-node
+drills stay readable without scanning 200 rows.
+
 ``--json`` swaps the table for ONE machine-readable JSON document per
 refresh (``snapshot()``'s shape: reference stamp, per-node latest rows,
 breached-node list), so downstream tooling — autoscalers, dashboards, CI
@@ -187,6 +193,47 @@ def _trace_columns(row: dict):
     )
 
 
+def fleet_summary(latest: Dict[str, dict]) -> dict:
+    """Fleet-wide roll-up for the footer row (ISSUE 19, satellite).
+
+    Aggregates the numbers a 200-node run needs readable without 200
+    rows: total MSG/S across the fleet, the worst single node's
+    staleness p99, the running SLO-breach-minutes and the current
+    scenario phase.  The last two ride every row's ``ctl`` block (the
+    aggregator stamps them fleet-wide), so the freshest row — highest
+    ``t_ingest`` — wins; older rows may predate a phase change.
+    """
+    msgs_total = 0.0
+    have_msgs = False
+    worst_stale = None
+    for row in latest.values():
+        m = row.get("msgs_per_s")
+        if m is not None:
+            msgs_total += float(m)
+            have_msgs = True
+        stale = _worst_staleness(row)
+        if stale is not None:
+            p99 = float(stale.get("p99") or 0.0)
+            if worst_stale is None or p99 > worst_stale:
+                worst_stale = p99
+    phase = None
+    breach_min = None
+    for row in sorted(
+        latest.values(), key=lambda r: float(r.get("t_ingest") or 0.0)
+    ):
+        ctl = row.get("ctl") or {}
+        if ctl.get("phase") is not None:
+            phase = ctl["phase"]
+        if ctl.get("breach_min") is not None:
+            breach_min = float(ctl["breach_min"])
+    return {
+        "msgs_per_s": round(msgs_total, 3) if have_msgs else None,
+        "worst_stale_p99": worst_stale,
+        "breach_minutes": breach_min,
+        "phase": phase,
+    }
+
+
 def snapshot(latest: Dict[str, dict], now: Optional[float] = None) -> dict:
     """One machine-readable fleet snapshot (the ``--json`` payload).
 
@@ -204,6 +251,7 @@ def snapshot(latest: Dict[str, dict], now: Optional[float] = None) -> dict:
         "t_ref": round(ref, 6),
         "n_nodes": len(latest),
         "breached": breached,
+        "fleet": fleet_summary(latest),
         "nodes": {
             n: dict(
                 latest[n],
@@ -303,6 +351,17 @@ def render(latest: Dict[str, dict], now: Optional[float] = None) -> List[str]:
             f"{int(drops) if drops is not None else '-':>4} "
             f"{mig:>3} {slo:<18} {flags}"
         )
+    fleet = fleet_summary(latest)
+    msgs = fleet["msgs_per_s"]
+    stale = fleet["worst_stale_p99"]
+    bmin = fleet["breach_minutes"]
+    lines.append(
+        f"== FLEET  MSG/S={f'{msgs:.1f}' if msgs is not None else '-'}  "
+        f"worst STALE p99="
+        f"{f'{stale:.0f}' if stale is not None else '-'}  "
+        f"breach-min={f'{bmin:.2f}' if bmin is not None else '-'}  "
+        f"phase={fleet['phase'] or '-'} =="
+    )
     lines.append(
         f"-- {len(latest)} nodes, {breached_total} breached; "
         "staleness in versions, rates per second --"
