@@ -33,6 +33,16 @@ class ConsistencyConfig:
     #: SSP staleness bound (the reference's ``max_delay`` flag); ignored for
     #: BSP (effectively 0) and ASP (effectively unbounded).
     max_delay: int = 0
+    #: graceful-degradation deadline (ISSUE 20): when a wire-enforced gate
+    #: (a ``__wait__`` defer loop) has held a request longer than this,
+    #: pulls shed to the stale serving path (bounded by the advertised
+    #: ``__sver__`` watermark) and pushes force through — never dropped.
+    #: <= 0 disables shedding (wait forever; tests assert invariants with
+    #: this).
+    gate_deadline_s: float = 5.0
+    #: base sleep between gate retries when the server's ``__wait__`` reply
+    #: does not advertise its own ``retry_after`` hint.
+    gate_retry_s: float = 0.005
 
     @property
     def bound(self) -> Optional[int]:
@@ -42,6 +52,16 @@ class ConsistencyConfig:
         if self.mode == ConsistencyMode.SSP:
             return self.max_delay
         return None
+
+    def __post_init__(self) -> None:
+        if self.max_delay < 0:
+            raise ValueError(
+                f"max_delay must be >= 0, got {self.max_delay!r}"
+            )
+        if self.gate_retry_s <= 0:
+            raise ValueError(
+                f"gate_retry_s must be > 0, got {self.gate_retry_s!r}"
+            )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -437,6 +457,13 @@ class TableConfig:
     fused_apply: bool = True
     #: lossy wire codec for this table's PUSH plane; None = bit-exact wire.
     compression: Optional[WireCompressionConfig] = None
+    #: wire-enforced consistency plane (ISSUE 20): when set, workers stamp
+    #: their committed step (``__cstep__``) on this table's PUSH/PULL
+    #: requests and servers gate them against the fleet's per-worker vector
+    #: clock — block-the-laggard (SSP), rendezvous-barrier (BSP) or
+    #: free-run (ASP).  None = ungated (the pre-ISSUE-20 wire, zero extra
+    #: payload bytes).
+    consistency: Optional[ConsistencyConfig] = None
 
 
 @dataclasses.dataclass(frozen=True)

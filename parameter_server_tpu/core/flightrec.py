@@ -165,6 +165,18 @@ EVENTS = frozenset({
     "scenario.heal",
     "scenario.action",
     "scenario.end",
+    # consistency plane (ISSUE 20, kv/server.py gate + kv/worker.py retry
+    # loops): gate = a sender's FIRST ``__wait__`` defer on a gated table
+    # (retries in between stay silent); release = that sender admitted
+    # again — a gate with no later release is the wedged-fleet postmortem
+    # anomaly anchor; shed = the gate deadline degraded a request (pull
+    # shed to the stale cache or forced through ungated, push forced —
+    # never dropped; how= says which); retune = the BoundTuner (or an
+    # operator / scenario phase) changed a table's live mode/bound
+    "consist.gate",
+    "consist.release",
+    "consist.shed",
+    "consist.retune",
 })
 
 #: env var: when set, recv-thread exceptions auto-dump a bundle here.
@@ -460,4 +472,5 @@ def anomaly_kinds() -> frozenset:
         "group.fallback",
         "ckpt.abort",
         "scenario.inject",
+        "consist.shed",
     })

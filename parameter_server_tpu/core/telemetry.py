@@ -525,6 +525,13 @@ class TelemetryAggregator:
         # ckpt-age SLO without any extra plumbing.
         if "ckpt_age_s" in cum_snapshot:
             row["ckpt_age_s"] = round(float(cum_snapshot["ckpt_age_s"]), 3)
+        # consistency plane (ISSUE 20): the server's mode/bound ride the
+        # counter channel as GAUGES (delta-framed like ckpt_age_s), so the
+        # reconstructed cumulative value IS the current setting — surface
+        # them for pstop's MODE/BOUND columns and the live-retune audit.
+        if "consist_mode" in cum_snapshot:
+            row["consist_mode"] = int(cum_snapshot["consist_mode"])
+            row["consist_bound"] = int(cum_snapshot.get("consist_bound", 0))
         if deliver.count:
             row["deliver_p99_ms"] = round(1e3 * deliver.percentile(0.99), 3)
             row["deliver_p50_ms"] = round(1e3 * deliver.percentile(0.50), 3)

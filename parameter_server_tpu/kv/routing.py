@@ -71,6 +71,22 @@ READ_ONLY_KEY = "__ro__"
 #: rolling-upgrade safe (MIGRATION.md).  Mirrored as ``_GROUP_KEY`` in
 #: ``core/filters.py`` (import would cycle); test_group asserts equality.
 GROUP_KEY = "__grp__"
+#: request payload key: the sender's committed step for the addressed
+#: table (ISSUE 20).  Stamped (plain int — stays on the fast meta codec)
+#: on PUSH/PULL only when ``TableConfig.consistency`` is set; servers fold
+#: it into their per-table fleet vector clock and gate the request against
+#: the configured BSP/SSP bound.  Unstamped requests (old workers, ungated
+#: tables) bypass the gate entirely — zero wire change.
+CONSIST_STEP_KEY = "__cstep__"
+#: reply payload key: typed consistency defer (ISSUE 20).  Stamped onto a
+#: reply that also carries ``FENCED_KEY`` + ``ROUTING_KEY`` — the reply is
+#: deliberately FENCE-SHAPED so pre-ISSUE-20 workers fall into their
+#: existing fence-retry loop (ignored-as-retry: MIGRATION.md) — plus the
+#: current fleet clock snapshot, fleet minimum, bound and a ``retry_after``
+#: backoff hint.  New workers check this key FIRST: a wait is not a fence
+#: (routing is fine), so waited positions retry without consuming the
+#: fence-retry budget, under the table's ``gate_deadline_s``.
+WAIT_KEY = "__wait__"
 
 
 @dataclasses.dataclass(frozen=True)

@@ -42,16 +42,19 @@ from parameter_server_tpu.core import flightrec
 from parameter_server_tpu.core.messages import Message, Task, TaskKind
 from parameter_server_tpu.core.postoffice import Customer, Postoffice
 from parameter_server_tpu.core.tracectx import TRACE_KEY
+from parameter_server_tpu.kv.consistency import MODE_CODES, FleetClock
 from parameter_server_tpu.kv.ledger import ApplyLedger
 from parameter_server_tpu.kv.partition import RangePartition
 from parameter_server_tpu.kv.routing import (
     BUSY_KEY,
+    CONSIST_STEP_KEY,
     FENCED_KEY,
     GROUP_KEY,
     READ_ONLY_KEY,
     ROUTING_EPOCH_KEY,
     ROUTING_KEY,
     VERSION_KEY,
+    WAIT_KEY,
     RoutingTable,
 )
 from parameter_server_tpu.kv.table import KVTable
@@ -176,6 +179,33 @@ class KVServer(Customer):
             t: LatencyHistogram() for t in table_cfgs
         }
         self.fenced_rejects = 0
+        # -- consistency plane (ISSUE 20) ------------------------------------
+        #: per-gated-table live state: mode/bound start from the table's
+        #: ConsistencyConfig but are retunable at runtime (``consist_set``
+        #: — the BoundTuner's lever and the scenario DSL's mode-flip knob);
+        #: the FleetClock is the vector clock of per-worker committed steps
+        #: fed by ``__cstep__`` stamps.  Mutated on the recv thread (plus
+        #: the van's incarnation callback — FleetClock locks internally).
+        self._consist: Dict[str, dict] = {}
+        for t, cfg in table_cfgs.items():
+            if cfg.consistency is not None:
+                self._consist[t] = {
+                    "cfg": cfg.consistency,
+                    "mode": cfg.consistency.mode,
+                    "bound": cfg.consistency.bound,
+                    "clock": FleetClock(),
+                }
+        self.consist_defers = 0
+        self.consist_releases = 0
+        #: senders currently parked on a ``__wait__`` defer, per table —
+        #: the gate/release event pairing the postmortem anchor keys on
+        #: (``consist.gate`` fires on FIRST defer, ``consist.release`` when
+        #: that sender is next admitted; retries in between stay silent).
+        self._consist_waiting: Dict[str, set] = {t: set() for t in self._consist}
+        if self._consist and hasattr(post.van, "on_incarnation_advance"):
+            # same-id restart fencing (ISSUE 20 satellite): the dead
+            # incarnation's clock entry must not wedge the fleet minimum
+            post.van.on_incarnation_advance.append(self._consist_incarnation)
         # -- sampled request tracing (ISSUE 18) ------------------------------
         #: server-side plane attribution across sampled requests, exported
         #: via :meth:`latency_digests`: ``trace.wire`` = worker submit ->
@@ -340,6 +370,64 @@ class KVServer(Customer):
         reply.task = dataclasses.replace(msg.task, payload=payload)
         return reply
 
+    def _wait_reply(self, msg: Message, tname: str, step: int, fm: int) -> Message:
+        """Typed consistency defer (ISSUE 20): the sender ran too far ahead.
+
+        Deliberately FENCE-SHAPED (``__error__`` + ``__fenced__`` + the
+        current routing table) so pre-ISSUE-20 workers treat it as a fence
+        and retry blindly — deferred, never dropped (MIGRATION.md).  New
+        workers key on ``__wait__`` first: routing is fine, so the retry
+        rides the gate budget (``gate_deadline_s``), not the fence budget,
+        honoring the ``retry_after`` backoff hint.  The fleet clock
+        snapshot rides along so the worker can see WHO it is waiting for.
+        """
+        st = self._consist[tname]
+        self.consist_defers += 1
+        waiting = self._consist_waiting[tname]
+        if msg.sender not in waiting:
+            waiting.add(msg.sender)
+            flightrec.record(
+                "consist.gate", node=self.post.node_id, sender=msg.sender,
+                table=tname, step=step, fleet_min=fm,
+                bound=int(st["bound"]),
+            )
+        reply = msg.reply()
+        gap = step - fm - int(st["bound"])
+        payload = {
+            "__error__": (
+                f"consistency gate ({st['mode'].value}): step {step} > "
+                f"fleet_min {fm} + bound {st['bound']} on {tname!r}"
+            ),
+            FENCED_KEY: True,
+            ROUTING_KEY: self.routing.to_payload(),
+            WAIT_KEY: True,
+            "clock": st["clock"].snapshot(),
+            "fleet_min": fm,
+            "bound": int(st["bound"]),
+            "retry_after": min(0.25, 0.002 * max(1, gap)),
+        }
+        tctx = msg.task.payload.get(TRACE_KEY)
+        if isinstance(tctx, dict) and tctx.get("tid") is not None:
+            # a defer is still a reply leg of the sampled span tree
+            payload[TRACE_KEY] = tctx
+            self._trace_disp.pop(tctx["tid"], None)
+            flightrec.record(
+                "trace.reply", tid=tctx["tid"], node=self.post.node_id,
+                verdict="wait",
+            )
+        payload["table"] = tname
+        payload[VERSION_KEY] = self.version_max(tname)
+        reply.task = dataclasses.replace(msg.task, payload=payload)
+        return reply
+
+    def _consist_incarnation(self, node_id: str, incarnation: int) -> None:
+        """Van callback: a peer restarted under the same id — prune the
+        dead incarnation's clock entry so it cannot wedge the fleet
+        minimum (the new incarnation re-registers via ``consist_hello``
+        or its first stamped request)."""
+        for st in self._consist.values():
+            st["clock"].on_incarnation_advance(node_id, incarnation)
+
     # -- staleness version clock (ISSUE 10) -----------------------------------
     def version_max(self, table: str) -> int:
         """Highest segment version of this shard (0 when it owns nothing)."""
@@ -469,6 +557,24 @@ class KVServer(Customer):
             "ckpt_delta_rows": self.ckpt_delta_rows,
             "ckpt_delta_overflow": self.ckpt_delta_overflow,
         }
+        if self._consist:
+            # consistency plane (ISSUE 20): defer/release totals plus the
+            # mode/bound gauges pstop's MODE/BOUND columns decode (first
+            # gated table by name — fleets gate one training table; the
+            # clock size/prune gauges make membership drift visible)
+            first = self._consist[sorted(self._consist)[0]]
+            out["consist_defers"] = self.consist_defers
+            out["consist_releases"] = self.consist_releases
+            out["consist_mode"] = MODE_CODES[first["mode"]]
+            out["consist_bound"] = (
+                -1 if first["bound"] is None else int(first["bound"])
+            )
+            out["consist_clock_size"] = sum(
+                st["clock"].size() for st in self._consist.values()
+            )
+            out["consist_pruned"] = sum(
+                st["clock"].pruned for st in self._consist.values()
+            )
         if self.ledger is not None:
             # device-plane gauges + totals (inflight_bundles/rows,
             # backlog_age_s, applies_*): ride the same counter channel —
@@ -589,6 +695,28 @@ class KVServer(Customer):
                 f"{len(np.asarray(msg.keys))} requested rows of {tname!r} "
                 f"at epoch {self.routing.epoch}",
             )
+        # consistency gate (ISSUE 20): a stamped request on a gated table
+        # must sit within ``bound`` of the fleet minimum or it is deferred
+        # with a typed ``__wait__`` reply.  AFTER the routing checks (a
+        # mis-routed request must fence, not wait) and only for stamped
+        # traffic — old workers and read-only serving pulls bypass.
+        cstep = msg.task.payload.get(CONSIST_STEP_KEY)
+        if cstep is not None and tname in self._consist:
+            st = self._consist[tname]
+            allowed, fm = st["clock"].gate(
+                msg.sender, int(cstep), st["bound"]
+            )
+            if not allowed:
+                return self._wait_reply(msg, tname, int(cstep), fm)
+            waiting = self._consist_waiting[tname]
+            if msg.sender in waiting:
+                waiting.discard(msg.sender)
+                self.consist_releases += 1
+                flightrec.record(
+                    "consist.release", node=self.post.node_id,
+                    sender=msg.sender, table=tname, step=int(cstep),
+                    fleet_min=fm,
+                )
         ids_np, kn, segs = loc
         return tname, ids_np, kn, segs
 
@@ -704,6 +832,12 @@ class KVServer(Customer):
         mode it deliberately blocks on the CHAIN ack, not on device work.)
         """
         self.pushes += 1
+        cstep = msg.task.payload.get(CONSIST_STEP_KEY)
+        if cstep is not None and tname in self._consist:
+            # consistency plane (ISSUE 20): the stamped push is APPLIED —
+            # the sender committed its step, so its vector-clock entry
+            # advances past it (pure dict/int ops: stays sync-free)
+            self._consist[tname]["clock"].commit(msg.sender, int(cstep))
         grp = msg.task.payload.get(GROUP_KEY)
         if grp is not None:
             # hierarchical push (ISSUE 15): this ONE apply stands for the
@@ -1553,7 +1687,65 @@ class KVServer(Customer):
                 msg.task.payload["root"], msg.task.payload["step"]
             )
             return msg.reply()
+        if op == "consist_hello":
+            return self._handle_consist_hello(msg)
+        if op == "consist_set":
+            return self._handle_consist_set(msg)
         raise ValueError(f"unsupported control op {op!r}")
+
+    # -- consistency plane control (ISSUE 20) --------------------------------
+    def _handle_consist_hello(self, msg: Message) -> Message:
+        """Register a worker in the fleet clock(s) BEFORE it trains.
+
+        Up-front registration is what stops a fast worker free-running
+        ahead during bring-up: until every peer's first stamped request
+        arrives, the clock would not know the fleet is bigger than the
+        senders it has seen.  Also the re-registration path after a
+        same-id restart (a newer incarnation replaces the dead entry at
+        the restored ``step``).
+        """
+        p = msg.task.payload
+        worker = str(p.get("worker") or msg.sender)
+        inc = int(p.get("incarnation", 0))
+        step = int(p.get("step", 0))
+        tname = p.get("table")
+        tables = [tname] if tname else list(self._consist)
+        for t in tables:
+            if t in self._consist:
+                self._consist[t]["clock"].hello(worker, inc, step)
+        return msg.reply()
+
+    def _handle_consist_set(self, msg: Message) -> Message:
+        """Live retune: change a gated table's mode and/or bound.
+
+        The BoundTuner's lever (bound only) and the scenario DSL's
+        ``consistency_mode`` phase knob (mode flip mid-run).  A mode flip
+        recomputes the bound from the mode semantics unless the payload
+        pins one explicitly.
+        """
+        from parameter_server_tpu.config import ConsistencyMode
+
+        p = msg.task.payload
+        tname = p.get("table")
+        tables = [tname] if tname else list(self._consist)
+        for t in tables:
+            st = self._consist.get(t)
+            if st is None:
+                continue
+            if p.get("mode") is not None:
+                mode = ConsistencyMode(p["mode"])
+                st["mode"] = mode
+                if mode == ConsistencyMode.BSP:
+                    st["bound"] = 0
+                elif mode == ConsistencyMode.ASP:
+                    st["bound"] = None
+                else:
+                    st["bound"] = int(
+                        p.get("bound", st["cfg"].max_delay)
+                    )
+            if p.get("bound") is not None:
+                st["bound"] = int(p["bound"])
+        return msg.reply()
 
     def save_checkpoint(self, root: str, step: int) -> None:
         """Write this server's row-range of every table (value + opt state).

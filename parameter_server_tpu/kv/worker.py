@@ -54,12 +54,14 @@ from parameter_server_tpu.kv.cache import HotRowCache
 from parameter_server_tpu.kv.partition import RangePartition
 from parameter_server_tpu.kv.routing import (
     BUSY_KEY,
+    CONSIST_STEP_KEY,
     FENCED_KEY,
     GROUP_KEY,
     READ_ONLY_KEY,
     ROUTING_EPOCH_KEY,
     ROUTING_KEY,
     VERSION_KEY,
+    WAIT_KEY,
     RoutingTable,
     WorkerGroup,
 )
@@ -230,6 +232,25 @@ class KVWorker(Customer):
         self.group_fallbacks = 0  # degradations to direct push
         self.group_done_recv = 0  # done notifies applied
         self.group_handoffs = 0  # fence re-elections handed to a new leader
+        # -- consistency plane (ISSUE 20) ------------------------------------
+        #: per-table committed step — how many :meth:`push_sync` calls for
+        #: the table fully completed.  This is the ``__cstep__`` value
+        #: stamped onto gated PUSH/PULL traffic (tables whose
+        #: ``TableConfig.consistency`` is set); servers fold it into their
+        #: fleet vector clock and gate against the configured bound.
+        self._consist_steps: Dict[str, int] = {}
+        self._consist_lock = threading.Lock()
+        #: ``__wait__`` defers received / pulls shed to the stale cache /
+        #: requests forced through ungated past the gate deadline
+        #: (Dashboard-mergeable via :meth:`counters`)
+        self.consist_waits = 0
+        self.consist_sheds = 0
+        self.consist_forced = 0
+        #: time parked on consistency gates (first defer -> admitted),
+        #: exported as ``consist.gate_wait`` via :meth:`latency_digests` —
+        #: the gate-wait-p99 SLO's series (utils/slo.py
+        #: consistency_plane_specs)
+        self._gate_hist = LatencyHistogram()
 
     def _serve_owner_codes(self, table: str, tr, cache) -> np.ndarray:
         """Owner :meth:`HotRowCache.server_code` per segment of ``tr``.
@@ -310,6 +331,19 @@ class KVWorker(Customer):
             )
         if self.cache is not None:
             out.update(self.cache.counters())
+        with self._consist_lock:
+            if self.consist_waits or self._consist_steps:
+                # consistency plane (ISSUE 20): defer/shed/force totals plus
+                # the committed-step gauge (sum over gated tables)
+                out["consist_waits"] = self.consist_waits
+                out["consist_sheds"] = self.consist_sheds
+                out["consist_forced"] = self.consist_forced
+                # combined degradation counter: the shed-rate SLO watches
+                # one cumulative series for "the gate deadline fired"
+                out["consist_degraded"] = (
+                    self.consist_sheds + self.consist_forced
+                )
+                out["consist_step"] = sum(self._consist_steps.values())
         return out
 
     def server_busy(self, server: str, within_s: float = 1.0) -> bool:
@@ -438,10 +472,51 @@ class KVWorker(Customer):
         segments.  Cumulative and monotone, same contract as the server's
         :meth:`~parameter_server_tpu.kv.server.KVServer.latency_digests`.
         """
+        out = {}
         with self._trace_lock:
-            if not self._trace_e2e.count:
-                return {}
-            return {"trace.e2e": self._trace_e2e.to_dict()}
+            if self._trace_e2e.count:
+                out["trace.e2e"] = self._trace_e2e.to_dict()
+        with self._consist_lock:
+            if self._gate_hist.count:
+                # consistency plane (ISSUE 20): seconds parked on gates
+                out["consist.gate_wait"] = self._gate_hist.to_dict()
+        return out
+
+    # -- consistency plane (ISSUE 20) -----------------------------------------
+    def consist_step(self, table: str) -> int:
+        """This worker's committed step for ``table`` (completed pushes)."""
+        with self._consist_lock:
+            return self._consist_steps.get(table, 0)
+
+    def _consist_commit(self, table: str) -> int:
+        with self._consist_lock:
+            s = self._consist_steps.get(table, 0) + 1
+            self._consist_steps[table] = s
+            return s
+
+    def _gated(self, table: str) -> bool:
+        return self.table_cfgs[table].consistency is not None
+
+    @staticmethod
+    def _scan_waits(responses, order) -> Tuple[list, list, list, float]:
+        """Split out typed ``__wait__`` consistency defers (ISSUE 20).
+
+        Wait replies are fence-SHAPED (they carry ``__fenced__`` too, for
+        old workers) but are not fences: routing is fine, the sender just
+        ran too far ahead of the fleet minimum.  Returns ``(rest, waits,
+        waited position arrays, max retry_after hint)`` so the retry loops
+        can park on the gate budget instead of burning fence retries.
+        """
+        rest, waits, pos, retry = [], [], [], 0.0
+        for resp in responses:
+            p = resp.task.payload
+            if p.get(WAIT_KEY):
+                waits.append(resp)
+                pos.append(order[resp.sender])
+                retry = max(retry, float(p.get("retry_after") or 0.0))
+            else:
+                rest.append(resp)
+        return rest, waits, pos, retry
 
     @staticmethod
     def _scan_fences(responses, order) -> Tuple[list, set, List[np.ndarray]]:
@@ -1005,18 +1080,29 @@ class KVWorker(Customer):
         *,
         keep: bool = False,
         tctx: Optional[dict] = None,
+        ungated: bool = False,
     ) -> Tuple[int, Dict[str, np.ndarray]]:
         """Wire one push of ``combined[positions]`` rows at global ids
         ``slots[positions]``; returns ``(ts, {server: positions})``.
 
         ``positions`` (absolute indices into ``slots``, ascending) defaults
         to all of them; fence retries pass only the rejected subset.
+        ``ungated=True`` skips the consistency stamp (ISSUE 20) — the
+        gate-deadline force-through path: the push bypasses the fleet gate
+        rather than being dropped.
         """
         tctx = tctx if tctx is not None else self._trace_ctx()
         routing = self.routing  # one consistent table per submit
         if positions is None:
             positions = np.arange(slots.shape[0], dtype=np.int64)
         sub = slots[positions]
+        # consistency plane (ISSUE 20): gated tables stamp the sender's
+        # committed step (a plain int — the fast meta codec stays eligible)
+        cstep = (
+            self.consist_step(table)
+            if not ungated and self._gated(table)
+            else None
+        )
         msgs, order = [], {}
         for s, rel, ids in routing.slice_ids(table, sub):
             abs_pos = positions[rel]
@@ -1025,6 +1111,8 @@ class KVWorker(Customer):
                 "table": table,
                 ROUTING_EPOCH_KEY: routing.epoch,
             }
+            if cstep is not None:
+                payload[CONSIST_STEP_KEY] = cstep
             if tctx is not None:
                 payload[TRACE_KEY] = tctx
             msgs.append(
@@ -1167,6 +1255,7 @@ class KVWorker(Customer):
         positions: Optional[np.ndarray] = None,
         *,
         read_only: bool = False,
+        ungated: bool = False,
     ) -> int:
         tctx = self._trace_ctx()
         routing = self.routing
@@ -1179,6 +1268,13 @@ class KVWorker(Customer):
             "table": table,
             ROUTING_EPOCH_KEY: routing.epoch,
         }
+        # consistency plane (ISSUE 20): training pulls on gated tables
+        # stamp the committed step so a lagging/ahead worker is gated at
+        # the server.  Read-only serving pulls are NEVER gated — they are
+        # the shed target — and ``ungated=True`` is the deadline
+        # force-through (fresh data can never violate a staleness bound).
+        if not read_only and not ungated and self._gated(table):
+            payload[CONSIST_STEP_KEY] = self.consist_step(table)
         if tctx is not None:
             payload[TRACE_KEY] = tctx
         if read_only:
@@ -1209,6 +1305,7 @@ class KVWorker(Customer):
             "slots": slots,
             "trace": tctx["tid"] if tctx is not None else None,
             "ro": read_only,
+            "ungated": ungated,
         }
         return ts
 
@@ -1237,6 +1334,7 @@ class KVWorker(Customer):
                 plan["shape"],
                 positions=pos,
                 read_only=plan.get("ro", False),
+                ungated=plan.get("ungated", False),
             )
             tid = self._pull_plans[ts].get("trace")
             with self.tracer.span("kv.pull.wait", ts=ts, retry=1, trace=tid):
@@ -1248,26 +1346,80 @@ class KVWorker(Customer):
             raise TimeoutError(f"pull ts={ts} timed out")
         return plan, responses, errs
 
+    def _shed_pull_stale(self, plan: dict, pos: np.ndarray):
+        """Answer the WAITED positions from the stale cache (ISSUE 20).
+
+        The gate-deadline shed target: the PR 13 stale serving path,
+        bounded by whatever ``__sver__`` each cached row's reply carried.
+        Returns a synthetic ``(positions, rows, sver, "cache")`` pair, or
+        None when any waited slot is uncached (the caller then forces an
+        ungated pull — fresh data, never a dropped read).
+        """
+        cache = self.cache
+        if cache is None:
+            return None
+        table = plan["table"]
+        cfg = self.table_cfgs[table]
+        grows = self.routing.tables[table].rows
+        rows = np.zeros((int(pos.shape[0]), cfg.dim), dtype=cfg.dtype)
+        sver = None
+        for j, sl in enumerate(plan["slots"][pos].tolist()):
+            if int(sl) >= grows:
+                continue  # bucket pad: stays zero, matching the wire reply
+            hit = cache.lookup_stale(table, int(sl))
+            if hit is None:
+                return None
+            rows[j] = hit[0]
+            sver = hit[1] if sver is None else min(sver, hit[1])
+        return pos, rows, sver, "cache"
+
+    def _gate_deadline_s(self, table: str) -> float:
+        cfg = self.table_cfgs[table].consistency
+        return cfg.gate_deadline_s if cfg is not None else 0.0
+
+    def _gate_pause(self, table: str, retry_after: float) -> None:
+        cfg = self.table_cfgs[table].consistency
+        base = cfg.gate_retry_s if cfg is not None else 0.005
+        time.sleep(max(retry_after, base))
+
     def _pull_pairs(self, ts: int, timeout: Optional[float]) -> tuple:
         """Resolve pull ``ts`` into ``(plan, [(positions, rows, sver,
         sender)])``, looping over routing fences: fenced legs adopt the
         attached table and only their positions are re-pulled (under the
         NEW epoch).  ``sver``/``sender`` let :meth:`pull_serve` stamp cache
         inserts with the version EACH REPLY actually carried — never the
-        watermark at insert time, which may have advanced concurrently."""
+        watermark at insert time, which may have advanced concurrently.
+
+        Consistency gates (ISSUE 20): ``__wait__`` defers are NOT fences —
+        waited positions retry on the gate budget (``gate_deadline_s``,
+        honoring the server's ``retry_after`` hint) without consuming
+        fence retries.  Past the deadline the read degrades gracefully:
+        shed to the stale cache when it covers the waited rows
+        (``consist.shed``), else forced through ungated — counted, never
+        dropped."""
         pairs: list = []
         first_plan = None
-        for attempt in range(self.max_fence_retries + 1):
+        attempt = 0  # fence budget only; gate waits ride their own clock
+        gate_t0 = None
+        forced = False
+        ungated = False
+        while attempt <= self.max_fence_retries:
             plan, responses, errs = self._await_pull(ts, timeout)
-            first_plan = first_plan or plan
+            if first_plan is None:
+                first_plan = plan
+                ungated = plan.get("ungated", False)
             self._adopt_from(responses)
+            responses, waits, wait_pos, retry_after = self._scan_waits(
+                responses, plan["order"]
+            )
             data, fenced_senders, fenced = self._scan_fences(
                 responses, plan["order"]
             )
-            real = self._real_errors(errs, fenced_senders)
+            skip = fenced_senders | {r.sender for r in waits}
+            real = self._real_errors(errs, skip)
             if real:  # a dropped leg must not read as zero weights
                 raise RuntimeError(f"pull ts={ts} failed on: " + "; ".join(real))
-            if len(responses) < len(plan["order"]):
+            if len(responses) + len(waits) < len(plan["order"]):
                 raise RuntimeError(
                     f"pull ts={ts} incomplete: {len(responses)}/"
                     f"{len(plan['order'])} servers answered (dead server?)"
@@ -1281,12 +1433,64 @@ class KVWorker(Customer):
                 )
                 for r in data
             )
-            if not fenced:
+            if not fenced and not waits:
+                if gate_t0 is not None:
+                    with self._consist_lock:
+                        self._gate_hist.record(
+                            max(time.monotonic() - gate_t0, 0.0)
+                        )
                 return first_plan, pairs
-            pos = np.sort(np.concatenate(fenced))
-            self.refresh_retries += 1
-            if attempt:  # mid-broadcast epoch bounce: outlast the window
-                time.sleep(self.fence_backoff * attempt)
+            pending = list(fenced)
+            if waits:
+                with self._consist_lock:
+                    self.consist_waits += len(waits)
+                if gate_t0 is None:
+                    gate_t0 = time.monotonic()
+                table = first_plan["table"]
+                deadline = self._gate_deadline_s(table)
+                waited = np.sort(np.concatenate(wait_pos))
+                if (
+                    deadline > 0
+                    and time.monotonic() - gate_t0 > deadline
+                    and not forced
+                ):
+                    # graceful degradation: past the deadline the read
+                    # sheds to the stale cache, else forces through
+                    shed = self._shed_pull_stale(first_plan, waited)
+                    with self._consist_lock:
+                        self._gate_hist.record(
+                            max(time.monotonic() - gate_t0, 0.0)
+                        )
+                    if shed is not None:
+                        pairs.append(shed)
+                        with self._consist_lock:
+                            self.consist_sheds += 1
+                        flightrec.record(
+                            "consist.shed", node=self.post.node_id,
+                            table=table, op="pull", how="stale-cache",
+                            n=int(waited.shape[0]),
+                        )
+                        if not fenced:
+                            return first_plan, pairs
+                    else:
+                        forced = ungated = True
+                        pending.append(waited)
+                        with self._consist_lock:
+                            self.consist_forced += 1
+                        flightrec.record(
+                            "consist.shed", node=self.post.node_id,
+                            table=table, op="pull", how="forced",
+                            n=int(waited.shape[0]),
+                        )
+                else:
+                    pending.append(waited)
+                    self._gate_pause(table, retry_after)
+            if fenced:
+                self.refresh_retries += 1
+                attempt += 1
+                if attempt > 1:  # mid-broadcast epoch bounce: outlast it
+                    time.sleep(self.fence_backoff * (attempt - 1))
+            pos = np.sort(np.concatenate(pending))
             ts = self._submit_pull(
                 first_plan["table"],
                 first_plan["slots"],
@@ -1294,6 +1498,7 @@ class KVWorker(Customer):
                 first_plan["shape"],
                 positions=pos,
                 read_only=first_plan.get("ro", False),
+                ungated=ungated,
             )
         raise RuntimeError(
             f"pull of {first_plan['table']!r}: routing fence retries "
@@ -1532,12 +1737,22 @@ class KVWorker(Customer):
         timeout: Optional[float] = None,
     ) -> int:
         """The direct (ungrouped) sync push loop over prepared planes —
-        also the group mode's no-loss degradation target."""
+        also the group mode's no-loss degradation target.
+
+        Consistency gates (ISSUE 20): ``__wait__`` defers park the waited
+        positions on the gate budget (no fence retries consumed).  Pushes
+        are NEVER dropped: past ``gate_deadline_s`` the remainder is
+        forced through ungated (``consist.shed``, how="forced").  A fully
+        acked push commits this worker's step for the table — the
+        ``__cstep__`` every later request stamps."""
         positions: Optional[np.ndarray] = None
         ts = -1
-        for attempt in range(self.max_fence_retries + 1):
+        attempt = 0  # fence budget only; gate waits ride their own clock
+        gate_t0 = None
+        ungated = False
+        while attempt <= self.max_fence_retries:
             ts, order = self._submit_push(
-                table, slots, combined, positions, keep=True
+                table, slots, combined, positions, keep=True, ungated=ungated
             )
             if not self.wait(ts, timeout):
                 if not self.retry_on_timeout:
@@ -1550,7 +1765,8 @@ class KVWorker(Customer):
                 self.take_responses(ts)
                 self.push_retries += 1
                 ts, order = self._submit_push(
-                    table, slots, combined, positions, keep=True
+                    table, slots, combined, positions, keep=True,
+                    ungated=ungated,
                 )
                 if not self.wait(ts, timeout):
                     self.cancel(ts, "push deadline (retry)", remote=True)
@@ -1559,18 +1775,58 @@ class KVWorker(Customer):
             errs = self.errors(ts)
             responses = self.take_responses(ts)
             self._adopt_from(responses)
+            responses, waits, wait_pos, retry_after = self._scan_waits(
+                responses, order
+            )
             _, fenced_senders, fenced = self._scan_fences(responses, order)
-            real = self._real_errors(errs, fenced_senders)
+            skip = fenced_senders | {r.sender for r in waits}
+            real = self._real_errors(errs, skip)
             if real:
                 raise RuntimeError(
                     f"push ts={ts} failed on: " + "; ".join(real)
                 )
-            if not fenced:
+            if not fenced and not waits:
+                if self._gated(table):
+                    self._consist_commit(table)
+                    if gate_t0 is not None:
+                        with self._consist_lock:
+                            self._gate_hist.record(
+                                max(time.monotonic() - gate_t0, 0.0)
+                            )
                 return ts
-            positions = np.sort(np.concatenate(fenced))
-            self.refresh_retries += 1
-            if attempt:  # mid-broadcast epoch bounce: outlast the window
-                time.sleep(self.fence_backoff * attempt)
+            pending = list(fenced)
+            if waits:
+                with self._consist_lock:
+                    self.consist_waits += len(waits)
+                if gate_t0 is None:
+                    gate_t0 = time.monotonic()
+                pending.append(np.sort(np.concatenate(wait_pos)))
+                deadline = self._gate_deadline_s(table)
+                if (
+                    deadline > 0
+                    and time.monotonic() - gate_t0 > deadline
+                    and not ungated
+                ):
+                    # never dropped: force the remainder through ungated
+                    ungated = True
+                    with self._consist_lock:
+                        self.consist_forced += 1
+                        self._gate_hist.record(
+                            max(time.monotonic() - gate_t0, 0.0)
+                        )
+                    flightrec.record(
+                        "consist.shed", node=self.post.node_id,
+                        table=table, op="push", how="forced",
+                        n=int(sum(p.shape[0] for p in wait_pos)),
+                    )
+                else:
+                    self._gate_pause(table, retry_after)
+            if fenced:
+                self.refresh_retries += 1
+                attempt += 1
+                if attempt > 1:  # mid-broadcast epoch bounce: outlast it
+                    time.sleep(self.fence_backoff * (attempt - 1))
+            positions = np.sort(np.concatenate(pending))
         raise RuntimeError(
             f"push of {table!r}: routing fence retries exhausted after "
             f"{self.max_fence_retries} refreshes"
@@ -1626,6 +1882,81 @@ class KVWorker(Customer):
             raise TimeoutError("load_model timed out")
         self.check(ts)
         self.take_responses(ts)
+
+    # -- consistency plane control (ISSUE 20) --------------------------------
+    def consist_hello(
+        self,
+        *,
+        table: Optional[str] = None,
+        step: Optional[int] = None,
+        incarnation: Optional[int] = None,
+        timeout: Optional[float] = 30.0,
+    ) -> None:
+        """Register this worker in every server's fleet clock up front.
+
+        Call BEFORE training on a gated table (ElasticTrainer and the
+        bench harness do): until every peer is registered, the clock
+        cannot know the fleet is larger than the senders it has seen, so
+        a fast worker could free-run ahead during bring-up.  After a
+        same-id restart, re-hello at the restored ``step`` with the new
+        incarnation — the dead incarnation's entry is replaced, not
+        wedged into the fleet minimum.
+        """
+        if incarnation is None:
+            reg = getattr(self.post.van, "incarnations", None)
+            incarnation = reg.get(self.post.node_id) if reg is not None else 0
+        if step is None:
+            step = (
+                self.consist_step(table)
+                if table is not None
+                else max(self._consist_steps.values(), default=0)
+            )
+        payload = {
+            "worker": self.post.node_id,
+            "incarnation": int(incarnation or 0),
+            "step": int(step),
+        }
+        if table is not None:
+            payload["table"] = table
+        ts = self._broadcast_control("consist_hello", payload)
+        if not self.wait(ts, timeout):
+            raise TimeoutError("consist_hello timed out")
+        self.check(ts)
+        self.take_responses(ts)
+
+    def set_consistency(
+        self,
+        *,
+        table: Optional[str] = None,
+        bound: Optional[int] = None,
+        mode: Optional[str] = None,
+        why: str = "manual",
+        timeout: Optional[float] = 30.0,
+    ) -> None:
+        """Live-retune the fleet's gate: new ``bound`` and/or ``mode``.
+
+        The BoundTuner's lever (bound only) and the scenario DSL's
+        ``consistency_mode`` phase knob (mode flips mid-run).  Broadcast
+        to every server, then flight-recorded as ``consist.retune`` so a
+        postmortem can line tuning decisions up against SLO breaches.
+        """
+        payload: dict = {}
+        if table is not None:
+            payload["table"] = table
+        if bound is not None:
+            payload["bound"] = int(bound)
+        if mode is not None:
+            payload["mode"] = str(mode)
+        ts = self._broadcast_control("consist_set", payload)
+        if not self.wait(ts, timeout):
+            raise TimeoutError("consist_set timed out")
+        self.check(ts)
+        self.take_responses(ts)
+        flightrec.record(
+            "consist.retune", node=self.post.node_id,
+            table=table or "*", bound=-1 if bound is None else int(bound),
+            mode=mode or "-", why=why[:120],
+        )
 
     def _broadcast_control(self, op: str, payload: dict) -> int:
         # broadcast to the CURRENT owner set (post-migration it need not be

@@ -48,6 +48,7 @@ import numpy as np
 from parameter_server_tpu.config import CheckpointConfig, ConsistencyConfig
 from parameter_server_tpu.core.clock import ConsistencyController
 from parameter_server_tpu.core.manager import Manager
+from parameter_server_tpu.kv.consistency import BoundTuner
 from parameter_server_tpu.kv.worker import KVWorker
 from parameter_server_tpu.learner.workload import WorkloadPool
 from parameter_server_tpu.models import linear
@@ -82,6 +83,9 @@ class ElasticTrainer:
         ckpt_every: int = 0,
         ckpt_config: Optional[CheckpointConfig] = None,
         timeout: float = 60.0,
+        bound_tuner: Optional[BoundTuner] = None,
+        wire_bottleneck: Optional[Callable[[], bool]] = None,
+        retune_interval_s: float = 1.0,
     ) -> None:
         self.workers = workers
         self.scheduler = scheduler
@@ -104,6 +108,14 @@ class ElasticTrainer:
         self.losses: List[float] = []
         self._loss_lock = threading.Lock()
         self._killed: set[str] = set()
+        # wire-enforced consistency plane (ISSUE 20): the trainer announces
+        # workers to the servers' FleetClocks up front and (optionally)
+        # closes the loop over the SSP bound
+        self.bound_tuner = bound_tuner
+        self._wire_bottleneck = wire_bottleneck or (lambda: False)
+        self.retune_interval_s = retune_interval_s
+        self._retune_lock = threading.Lock()
+        self._next_retune = 0.0
         # membership -> pool/clock wiring (Executor::ReplaceNode analogue)
         scheduler.on_node_dead.append(self._on_dead)
         scheduler.on_node_added.append(self._on_added)
@@ -128,6 +140,70 @@ class ElasticTrainer:
         idx = self._index.get(node_id)
         if idx is not None:
             self.controller.mark_alive(idx)
+        # a re-added worker re-announces to the servers' FleetClocks: its
+        # hello carries the van's current incarnation, so a same-id restart
+        # replaces the dead incarnation's entry instead of racing it
+        kv = self.workers.get(node_id)
+        if kv is not None:
+            self._hello_one(node_id, kv)
+
+    # -- wire-enforced consistency (ISSUE 20) --------------------------------
+    def _gated_tables(self, kv: KVWorker) -> List[str]:
+        return sorted(
+            t for t, c in kv.table_cfgs.items() if c.consistency is not None
+        )
+
+    def _hello_one(self, wid: str, kv: KVWorker) -> None:
+        """Best-effort ``consist_hello`` for one worker's gated tables.
+
+        Registration keeps a slow-to-start worker from letting the rest of
+        the fleet free-run past the bound before its first stamped request;
+        a hello that times out (dead server mid-restart) is non-fatal — the
+        worker's first stamped request registers it anyway.
+        """
+        for t in self._gated_tables(kv):
+            try:
+                kv.consist_hello(table=t, timeout=self.timeout)
+            except (TimeoutError, RuntimeError) as e:
+                log.warning("consist_hello(%s, %s) failed: %s", wid, t, e)
+
+    def announce_consistency(self) -> None:
+        """Register every live worker with the servers' FleetClocks."""
+        for wid, kv in self.workers.items():
+            if wid not in self._killed:
+                self._hello_one(wid, kv)
+
+    def _maybe_retune(self, kv: KVWorker, loss: float) -> None:
+        """Feed the BoundTuner and apply its verdict fleet-wide.
+
+        Runs on worker threads at loss-record time; the interval check and
+        lock keep the tuner single-file.  A verdict is applied through any
+        live worker's ``consist_set`` broadcast, which also records the
+        ``consist.retune`` flight-recorder event with the tuner's reason.
+        """
+        tuner = self.bound_tuner
+        if tuner is None:
+            return
+        with self._retune_lock:
+            tuner.observe_loss(loss)
+            now = time.monotonic()
+            if now < self._next_retune:
+                return
+            self._next_retune = now + self.retune_interval_s
+            verdict = tuner.maybe_retune(
+                now, wire_bottleneck=self._wire_bottleneck()
+            )
+        if verdict is None:
+            return
+        new_bound, why = verdict
+        try:
+            kv.set_consistency(
+                table=self.table, bound=new_bound, why=why,
+                timeout=self.timeout,
+            )
+            log.info("retuned SSP bound -> %d (%s)", new_bound, why)
+        except (TimeoutError, RuntimeError) as e:  # pragma: no cover
+            log.warning("set_consistency(bound=%d) failed: %s", new_bound, e)
 
     # -- training ------------------------------------------------------------
     def run(self, *, poll: float = 0.02) -> List[float]:
@@ -137,6 +213,7 @@ class ElasticTrainer:
         — the scheduler's failure detection re-queues their work; only a
         wholly-failed run (work left but no live workers) raises.
         """
+        self.announce_consistency()
         hb_stop = threading.Event()
         hb_thread = None
         started_monitor = False
@@ -242,6 +319,7 @@ class ElasticTrainer:
                     iteration += 1
                     with self._loss_lock:
                         self.losses.append(float(loss))
+                    self._maybe_retune(kv, float(loss))
             except (TimeoutError, RuntimeError) as e:
                 # This worker is partitioned/dead from the cluster's view
                 # (pull timeout, undeliverable sends, or a dead-server leg) —

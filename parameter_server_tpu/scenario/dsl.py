@@ -94,16 +94,41 @@ class LoadCurve:
         return self.base
 
 
+#: phase-level consistency-plane settings (ISSUE 20): mode names match
+#: ``config.ConsistencyMode`` values; the runner applies them through the
+#: ``consist_set`` control broadcast at the phase boundary.
+_CONSIST_MODES = ("bsp", "ssp", "asp")
+
+
 @dataclasses.dataclass(frozen=True)
 class Phase:
     name: str
     duration_s: float
     load: LoadCurve = LoadCurve()
+    #: flip the fleet's gated tables to this consistency mode at phase
+    #: start (None = leave as-is).  Lets a war game answer "does BSP
+    #: survive this straggler cascade, and what does SSP(4) buy us?"
+    #: inside one scenario.
+    consistency_mode: Optional[str] = None
+    #: SSP staleness bound for the flip (ignored unless mode == "ssp").
+    consistency_bound: int = 4
 
     def __post_init__(self) -> None:
         if self.duration_s <= 0:
             raise ValueError(
                 f"phase {self.name!r}: duration_s must be > 0"
+            )
+        if (
+            self.consistency_mode is not None
+            and self.consistency_mode not in _CONSIST_MODES
+        ):
+            raise ValueError(
+                f"phase {self.name!r}: consistency_mode must be one of "
+                f"{_CONSIST_MODES}, got {self.consistency_mode!r}"
+            )
+        if self.consistency_bound < 0:
+            raise ValueError(
+                f"phase {self.name!r}: consistency_bound must be >= 0"
             )
 
 
@@ -232,9 +257,12 @@ def compile_schedule(scenario: Scenario) -> List[dict]:
     hot = rng.choice(servers)
     events.append({"t": 0.0, "event": "hot_shift", "node": hot})
     for p in scenario.phases:
-        events.append(
-            {"t": starts[p.name], "event": "phase", "phase": p.name}
-        )
+        ev = {"t": starts[p.name], "event": "phase", "phase": p.name}
+        if p.consistency_mode is not None:
+            ev["consistency_mode"] = p.consistency_mode
+            if p.consistency_mode == "ssp":
+                ev["consistency_bound"] = p.consistency_bound
+        events.append(ev)
         if p.load.kind == "flash_crowd" and p.load.shift_hot_set:
             hot = rng.choice([s for s in servers if s != hot])
             events.append({

@@ -243,6 +243,14 @@ class ScenarioRunner:
         self.actions: List[dict] = []
         self.now = 0.0
         self.phase: Optional[str] = None
+        #: consistency plane (ISSUE 20): the phase knob's current setting.
+        #: The runner simulates load, not training, so the flip is state +
+        #: callbacks: a driver running a REAL fleet appends a callable
+        #: ``(mode, bound) -> None`` (typically a ``consist_set`` broadcast
+        #: through any live worker) to ``on_consistency_mode``.
+        self.consistency_mode: Optional[str] = None
+        self.consistency_bound: Optional[int] = None
+        self.on_consistency_mode: List = []
         #: synthetic sampled-request trace events (critpath.py shapes,
         #: pre-rebased: ``t_s`` is virtual time) for the incident report.
         self.trace_events: List[dict] = []
@@ -356,6 +364,18 @@ class ScenarioRunner:
                 "scenario.phase", node=SCHEDULER, phase=ev["phase"],
                 t_virtual=ev["t"],
             )
+            mode = ev.get("consistency_mode")
+            if mode is not None:
+                bound = ev.get("consistency_bound")
+                self.consistency_mode = mode
+                self.consistency_bound = bound
+                for cb in self.on_consistency_mode:
+                    cb(mode, bound)
+                flightrec.record(
+                    "consist.retune", node=SCHEDULER, table="*",
+                    mode=mode, bound=-1 if bound is None else int(bound),
+                    why=f"scenario phase {ev['phase']}",
+                )
         elif kind == "hot_shift":
             if self.hot_node is not None:
                 self.extra_weight.pop(self.hot_node, None)

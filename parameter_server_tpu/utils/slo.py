@@ -569,6 +569,46 @@ def tracing_plane_specs(
     ]
 
 
+def consistency_plane_specs(
+    *,
+    gate_wait_p99_ms: float = 250.0,
+    shed_per_s: float = 1.0,
+    window_s: float = 10.0,
+) -> List[SloSpec]:
+    """The ISSUE-20 consistency-plane SLO pair.
+
+    - ``gate-wait-p99``: windowed p99 of the worker's ``consist.gate_wait``
+      digest — wall time a gated pull/push spent parked on ``__wait__``
+      replies before the server admitted it.  Breaching means the wire
+      (the staleness bound), not the device, is the fleet's bottleneck —
+      the exact signal :class:`~parameter_server_tpu.kv.consistency.
+      BoundTuner` consumes as its ``wire_bottleneck`` verdict to WIDEN
+      the SSP bound.
+    - ``shed-rate``: per-second rate of the worker's cumulative
+      ``consist_degraded`` counter (stale-cache sheds + forced ungated
+      requests).  Degradation is deliberate — bounded by the advertised
+      ``__sver__`` watermark and flight-recorded — but a sustained rate
+      means the gate deadline is doing the consistency plane's job, i.e.
+      the configured mode is not actually being enforced.
+    """
+    return [
+        SloSpec(
+            "gate-wait-p99",
+            "consist.gate_wait",
+            gate_wait_p99_ms,
+            source="p99",
+            window_s=window_s,
+        ),
+        SloSpec(
+            "shed-rate",
+            "consist_degraded",
+            shed_per_s,
+            source="rate",
+            window_s=window_s,
+        ),
+    ]
+
+
 def _delta_hist(first: dict, last: dict) -> LatencyHistogram:
     """Histogram of the samples recorded BETWEEN two cumulative digests.
 

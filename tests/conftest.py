@@ -50,7 +50,8 @@ def pytest_runtest_makereport(item, call):
     rebalance tests (PR 6) get the same one-line repro contract — their
     kill-mid-stream and skew scenarios are seed-driven the same way, as do
     the durability-plane ``checkpoint`` drills (PR 16: kill-mid-snapshot,
-    torn-file, reshard-restore).
+    torn-file, reshard-restore) and the ``consistency``-plane gate drills
+    (PR 20: SSP bound under seeded chaos, restart, migration).
     """
     outcome = yield
     report = outcome.get_result()
@@ -60,6 +61,7 @@ def pytest_runtest_makereport(item, call):
         "chaos" not in item.keywords
         and "migration" not in item.keywords
         and "checkpoint" not in item.keywords
+        and "consistency" not in item.keywords
     ):
         return
     seeds = {}

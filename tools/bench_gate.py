@@ -3,7 +3,8 @@
 
 ``bench.py``'s arms (``--wire``/``--obs``/``--apply``/``--devobs``/
 ``--serve``/``--compress``/``--hier``/``--ckpt``/``--transport``/
-``--traceplane``/``--wargame``) auto-record their headline numbers into
+``--traceplane``/``--wargame``/``--consistency``) auto-record their
+headline numbers into
 marker blocks of
 ``BASELINE.md``; ``tools/benchdiff.py`` can diff two revisions of that
 file cell-by-cell.  This tool closes the loop as a GATE a CI job (or a

@@ -234,6 +234,14 @@ REQUIRED_EVENTS = frozenset({
     "scenario.heal",
     "scenario.action",
     "scenario.end",
+    # consistency plane (ISSUE 20): gate/release pair the postmortem
+    # wedged-gate anchor matches on, the graceful-degradation shed edge,
+    # and the BoundTuner's retune trail — dropping any of these would
+    # silence the enforcement plane's observability.
+    "consist.gate",
+    "consist.release",
+    "consist.shed",
+    "consist.retune",
 })
 
 #: ``np.<attr>`` calls that materialize a device array on the host.
@@ -249,7 +257,7 @@ _SYNC_BANNED_NP = frozenset({"asarray", "array"})
 TRACE_GATED_FUNCS = {
     "kv/worker.py": frozenset({"_trace_submitted", "_on_response"}),
     "kv/server.py": frozenset(
-        {"_trace_dispatch", "_stamp_version", "_fence_reply"}
+        {"_trace_dispatch", "_stamp_version", "_fence_reply", "_wait_reply"}
     ),
     "kv/ledger.py": frozenset({"_retire"}),
     "core/tcp_van.py": frozenset({"_send_on_conn", "_dispatch_frame"}),

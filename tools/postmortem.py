@@ -30,7 +30,11 @@ Usage::
 The report prints the merged timeline tail — the "last N events before the
 first anomaly" (gave-up, fence, restart, abort, recv.exception,
 slo.breach...), plus everything after it — and ``-o`` writes the full
-merged timeline as JSON for tooling.
+merged timeline as JSON for tooling.  Two synthesized anchors rank
+alongside journaled anomalies: an unclosed sampled span tree (ISSUE 18,
+``trace.submit`` never acked) and an unreleased consistency gate
+(ISSUE 20, ``consist.gate`` with no later ``consist.release`` for the
+same server/sender/table — the fleet-minimum-stalled deadlock signature).
 """
 
 from __future__ import annotations
@@ -58,6 +62,7 @@ ANOMALY_KINDS = frozenset({
     "group.fallback",
     "ckpt.abort",
     "scenario.inject",
+    "consist.shed",
 })
 
 
@@ -145,6 +150,33 @@ def orphan_traces(merged: dict) -> List[dict]:
     ]
 
 
+def unreleased_gates(merged: dict) -> List[dict]:
+    """Consistency gates that never released (ISSUE 20).
+
+    The server records ``consist.gate`` the FIRST time it defers a
+    sender on a table and ``consist.release`` when that sender's next
+    stamped request is admitted — so in a healthy fleet every gate event
+    eventually pairs with a release (or the sender degrades through a
+    ``consist.shed`` and re-pairs on its next admitted step).  A gate
+    with no later release for the same (server, sender, table) is the
+    consistency plane's deadlock signature: the fleet minimum stopped
+    advancing while this sender was parked — a dead straggler that was
+    never pruned, or a barrier the rest of the fleet never reached.
+    """
+    events = merged["events"]
+    open_gates: Dict[tuple, dict] = {}
+    for ev in events:
+        kind = ev.get("kind")
+        if kind not in ("consist.gate", "consist.release"):
+            continue
+        key = (ev.get("node"), ev.get("sender"), ev.get("table"))
+        if kind == "consist.gate":
+            open_gates.setdefault(key, ev)
+        else:
+            open_gates.pop(key, None)
+    return sorted(open_gates.values(), key=lambda e: e["t_s"])
+
+
 def first_anomaly(events: List[dict]) -> Optional[int]:
     """Index of the first anomalous event in a merged timeline, or None."""
     for i, ev in enumerate(events):
@@ -185,29 +217,40 @@ def report(merged: dict, *, last: int = 30) -> List[str]:
         return lines + ["  (empty timeline)"]
     anom = first_anomaly(events)
     orphans = orphan_traces(merged)
+    idx = {id(e): i for i, e in enumerate(events)}
     o_first = None
     if orphans:
-        idx = {id(e): i for i, e in enumerate(events)}
         o_first = min(
             (idx[id(o["chain"][0])] for o in orphans if o["chain"]),
             default=None,
         )
-    if anom is None and o_first is None:
+    # a gate the server never released anchors the report exactly like an
+    # orphaned span: the defer is the last confirmed sighting of a sender
+    # the fleet minimum then strands (ISSUE 20)
+    gates = unreleased_gates(merged)
+    g_first = min((idx[id(g)] for g in gates), default=None)
+    candidates = [i for i in (anom, o_first, g_first) if i is not None]
+    if not candidates:
         lines.append("no anomalies recorded; timeline tail:")
         window = events[-last:]
     else:
-        if anom is None or (o_first is not None and o_first < anom):
-            anchor = o_first
-            ev = events[anchor]
+        anchor = min(candidates)
+        ev = events[anchor]
+        if anchor == o_first and (anom is None or anchor < anom):
             lines.append(
                 f"first anomaly: [{anchor}] unclosed span tree "
                 f"{(ev.get('tid') or (ev.get('tids') or ['?'])[0])} "
                 f"({ev['kind']} on {ev['node']} at t={ev['t_s']:.6f}, "
                 "no trace.ack ever followed)"
             )
+        elif anchor == g_first and (anom is None or anchor < anom):
+            lines.append(
+                f"first anomaly: [{anchor}] consistency gate never "
+                f"released: {ev['node']} deferred {ev.get('sender')} on "
+                f"{ev.get('table')!r} at t={ev['t_s']:.6f} and no "
+                "consist.release ever followed (fleet minimum stalled)"
+            )
         else:
-            anchor = anom
-            ev = events[anchor]
             lines.append(
                 f"first anomaly: [{anchor}] {ev['kind']} on {ev['node']} "
                 f"at t={ev['t_s']:.6f}"
@@ -230,6 +273,17 @@ def report(merged: dict, *, last: int = 30) -> List[str]:
             chain_t0 = o["chain"][0]["t_s"] if o["chain"] else o["t_s"]
             for ev in o["chain"]:
                 lines.append(" " + _row(ev, chain_t0))
+    if gates:
+        lines.append(
+            f"unreleased consistency gates: {len(gates)} sender(s) "
+            "deferred and never re-admitted"
+        )
+        for g in gates:
+            lines.append(
+                f"  {g['node']} gated {g.get('sender')} on "
+                f"{g.get('table')!r} at t={g['t_s']:.6f} "
+                f"(step={g.get('step')}, fleet_min={g.get('fleet_min')})"
+            )
     return lines
 
 

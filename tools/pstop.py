@@ -55,6 +55,15 @@ Columns:
                 restored from) a durable snapshot — the durability
                 plane's ``ckpt_age_s`` gauge (servers only; ``-`` for
                 nodes that never snapshot);
+- ``MODE``      consistency-plane mode on the node's gated tables
+                (``bsp``/``ssp``/``asp``, servers; ``-`` = ungated,
+                ISSUE 20);
+- ``BOUND``     the active SSP staleness bound (``0`` under BSP,
+                ``inf`` under ASP) — live, so a BoundTuner retune shows
+                up within one telemetry beat;
+- ``GATEms``    p99 wall time a gated pull/push spent parked on
+                ``__wait__`` replies before admission (the worker's
+                ``consist.gate_wait`` digest), milliseconds;
 - ``DRP``       cumulative telemetry frames the aggregator dropped for
                 this node (duplicates/stale seq — control-plane health);
 - ``MIG``       active migrations (begin - commit - abort event totals);
@@ -94,8 +103,32 @@ _HEADER = (
     f"{'APLYms':>7} {'WIREus':>7} {'SQus':>6} {'APLY%':>6} "
     f"{'RO/S':>7} {'HIT%':>5} {'CMPR%':>6} {'GRP%':>6} "
     f"{'SHED/S':>7} {'CKPT':>6} "
+    f"{'MODE':>4} {'BOUND':>5} {'GATEms':>7} "
     f"{'DRP':>4} {'MIG':>3} {'SLO':<18} FLAGS"
 )
+
+#: consistency-plane mode gauge decode (mirrors kv/consistency.MODE_CODES;
+#: 0 / absent = no gated tables on the node).
+_MODE_NAMES = {0: "-", 1: "bsp", 2: "ssp", 3: "asp"}
+
+
+def _consist_columns(row: dict):
+    """(mode_str, bound_str, gate_p99_ms) for the consistency plane.
+
+    Mode/bound come from the aggregator's derived gauges (servers with a
+    gated table); the gate-wait p99 comes from the WORKER's
+    ``consist.gate_wait`` digest — so in a fleet view the server rows
+    show MODE/BOUND and the worker rows show GATEms, which is where each
+    number is actually measured.
+    """
+    mode = row.get("consist_mode")
+    mode_s = _MODE_NAMES.get(int(mode), "?") if mode is not None else None
+    bound = row.get("consist_bound")
+    bound_s = None
+    if mode is not None and bound is not None:
+        bound_s = "inf" if int(bound) < 0 else str(int(bound))
+    gate = _trace_p99_s(row, "consist.gate_wait")
+    return mode_s, bound_s, None if gate is None else 1e3 * gate
 
 
 def load_rows(path: str) -> Dict[str, dict]:
@@ -320,6 +353,8 @@ def render(latest: Dict[str, dict], now: Optional[float] = None) -> List[str]:
         ckpt = row.get("ckpt_age_s")
         if ckpt is None:
             ckpt = counters.get("ckpt_age_s")
+        # consistency plane (ISSUE 20): mode/bound gauges + gate-wait p99
+        mode_s, bound_s, gate_ms = _consist_columns(row)
         drops = (row.get("ctl") or {}).get("drops")
         healthy = row.get("healthy")
         if healthy is None:
@@ -348,6 +383,9 @@ def render(latest: Dict[str, dict], now: Optional[float] = None) -> List[str]:
             f"{f'{grp:.1f}' if grp is not None else '-':>6} "
             f"{f'{shed_s:.1f}' if shed_s is not None else '-':>7} "
             f"{f'{float(ckpt):.1f}' if ckpt is not None else '-':>6} "
+            f"{mode_s if mode_s is not None else '-':>4} "
+            f"{bound_s if bound_s is not None else '-':>5} "
+            f"{f'{gate_ms:.1f}' if gate_ms is not None else '-':>7} "
             f"{int(drops) if drops is not None else '-':>4} "
             f"{mig:>3} {slo:<18} {flags}"
         )
